@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Benchmark entry point — prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Measures steady-state training throughput (images/sec) of the flagship
+MNIST CNN under sync-replica SGD semantics on whatever devices are
+visible (one TPU chip under the driver; the virtual CPU mesh works too).
+
+The reference publishes no numbers (README.md:1 is bare — SURVEY §6),
+so vs_baseline is reported against the north-star-derived nominal in
+BASELINE.json when present, else 1.0.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+
+def main() -> None:
+    from distributedmnist_tpu.core.config import ExperimentConfig
+    from distributedmnist_tpu.core.mesh import make_topology
+    from distributedmnist_tpu.data.datasets import make_synthetic
+    from distributedmnist_tpu.models.registry import get_model
+    from distributedmnist_tpu.parallel.api import build_train_step, init_train_state
+    from distributedmnist_tpu.train.lr_schedule import constant
+
+    n_dev = len(jax.devices())
+    batch = 4096 * max(1, n_dev)
+    cfg = ExperimentConfig.from_dict({
+        "data": {"dataset": "synthetic", "batch_size": batch},
+        "model": {"compute_dtype": "bfloat16"},
+        "sync": {"mode": "sync"},
+    })
+    topo = make_topology()
+    model = get_model(cfg.model)
+    state = topo.device_put_replicated(init_train_state(model, cfg))
+    step_fn = build_train_step(model, cfg, topo, constant(8e-4))
+
+    ds = make_synthetic(num_train=batch, num_test=256)
+    host_batch = {"image": ds.train.images[:batch], "label": ds.train.labels[:batch]}
+    gbatch = topo.device_put_batch(host_batch)
+
+    warmup, timed = 10, 50
+    for _ in range(warmup):
+        state, metrics = step_fn(state, gbatch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(timed):
+        state, metrics = step_fn(state, gbatch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    images_per_sec = timed * batch / dt
+    per_chip = images_per_sec / n_dev
+
+    baseline = None
+    try:
+        with open("BASELINE.json") as f:
+            baseline = json.load(f).get("published", {}).get("images_per_sec_per_chip")
+    except (OSError, json.JSONDecodeError):
+        pass
+    vs = per_chip / baseline if baseline else 1.0
+
+    print(json.dumps({
+        "metric": "mnist_cnn_sync_sgd_images_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+    # extra context on stderr (never pollutes the JSON line)
+    print(f"# devices={n_dev} global_batch={batch} steps={timed} "
+          f"wall={dt:.3f}s total={images_per_sec:.0f} img/s", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

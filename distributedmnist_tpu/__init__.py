@@ -1,0 +1,40 @@
+"""distributedmnist_tpu — a TPU-native distributed-training framework.
+
+A ground-up JAX/XLA re-design of the capabilities of
+agnusmaximus/DistributedMNIST (a TF-1.x parameter-server codebase for
+studying synchronous distributed SGD under stragglers; see
+/root/reference/src/distributed_train.py).
+
+Architecture stance (vs. the reference's PS star):
+
+* One SPMD program over a `jax.sharding.Mesh` — no parameter-server /
+  worker split, no gRPC star, no token queues
+  (reference: src/mnist_distributed_train.py:25-35,
+  src/sync_replicas_optimizer_modified/sync_replicas_optimizer_modified.py:199-206).
+* Replicated parameters; gradients reduced with a **masked mean psum**
+  over the ICI mesh: ``psum(grad * flag) / psum(flag)``.
+* Every aggregation discipline of the reference — k-of-n quorum /
+  backup workers, wall-clock interval pacing, deadline straggler drop,
+  full-barrier CDF instrumentation, drop-connect — is expressed as a
+  per-replica contribution-mask policy inside that single reduction
+  (reference: sync_replicas_optimizer_modified.py:237-429,
+  src/timeout_manager.py, src/distributed_train.py:194-196).
+
+Package layout:
+
+* ``core``     — configs, mesh/topology discovery, PRNG policy, logging.
+* ``data``     — idx loaders (MNIST / Fashion-MNIST), CIFAR-10, synthetic
+                 data, host-sharded batching, native C++ prefetch pipeline.
+* ``models``   — pure-function models (LeNet-style CNN, ResNet-20,
+                 a small transformer for the long-context path).
+* ``ops``      — masked reductions, drop-connect, ring attention.
+* ``parallel`` — the SPMD train step and mask policies (the heart;
+                 replaces reference L3+L4).
+* ``train``    — train loop, LR schedule, checkpoint/resume.
+* ``evalsvc``  — continuous checkpoint evaluator (≙ src/nn_eval.py).
+* ``obsv``     — step-time CDFs, profiler traces, metric sinks.
+* ``launch``   — topology bring-up and experiment sweep runner
+                 (≙ tools/tf_ec2.py + tools/benchmark.py + cfg/).
+"""
+
+__version__ = "0.1.0"

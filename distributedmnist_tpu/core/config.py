@@ -1,0 +1,301 @@
+"""Typed experiment configuration.
+
+Replaces the reference's two-tier flag system — ~25 global
+``tf.app.flags`` (reference: src/distributed_train.py:36-99) plus
+``eval()``-loaded ``Cfg`` dict literals with %-interpolation
+(reference: tools/tf_ec2.py:17-25, tools/benchmark.py:13-15) — with
+frozen dataclasses, safe literal config files (JSON or Python literals
+via ``ast.literal_eval``, never ``eval``), and dotted-path CLI
+overrides.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    """Dataset selection and ingest policy (≙ src/mnist_data.py)."""
+
+    dataset: str = "mnist"  # mnist | fashion_mnist | cifar10 | synthetic
+    data_dir: str = "/tmp/dmt_data"
+    # Global batch size across all replicas. The reference's
+    # ``batch_size`` flag (src/distributed_train.py:63) is *per worker*;
+    # here per-replica batch = batch_size // num_replicas.
+    batch_size: int = 128
+    # "sharded": deterministic per-host split (fixes the reference's
+    # ignored worker_id/n_workers args, src/mnist_data.py:156-163,212-213).
+    # "independent": each replica samples its own shuffle of the full
+    # train set — faithful to the reference's behavior
+    # (src/mnist_data.py:55,80-84).
+    shard_mode: str = "sharded"
+    # Synthetic-data fallback (≙ the latent fake_data fixture,
+    # src/mnist_data.py:164-172) — also the default when no idx files
+    # exist on disk (this environment has no network egress).
+    synthetic_train_size: int = 8192
+    synthetic_test_size: int = 2048
+    use_native_pipeline: bool = True  # C++ prefetch loader when built
+    prefetch_batches: int = 2
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Model family + numerics (≙ src/mnist.py)."""
+
+    name: str = "mnist_cnn"  # mnist_cnn | resnet20 | transformer
+    # Reference fixes its init seed at 66478 (src/mnist.py:32).
+    init_seed: int = 66478
+    dropout_rate: float = 0.5  # src/mnist.py:140
+    num_classes: int = 10
+    image_size: int = 28
+    num_channels: int = 1
+    # bfloat16 activations/matmuls feed the MXU; params stay float32.
+    compute_dtype: str = "bfloat16"
+    # transformer (long-context path) only:
+    seq_len: int = 512
+    model_dim: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    vocab_size: int = 256
+
+
+@dataclass(frozen=True)
+class OptimConfig:
+    """SGD + exponential staircase decay (≙ src/distributed_train.py:88-99,143-156)."""
+
+    initial_learning_rate: float = 0.1
+    num_epochs_per_decay: float = 2.0
+    learning_rate_decay_factor: float = 0.999
+    staircase: bool = True
+    # decay_steps = batches_per_epoch * num_epochs_per_decay / k where k
+    # is the aggregation quorum (src/distributed_train.py:147).
+    momentum: float = 0.0  # reference uses plain GradientDescentOptimizer (:176)
+
+
+@dataclass(frozen=True)
+class SyncConfig:
+    """Aggregation discipline — the reference's core contribution (SURVEY §2.2).
+
+    mode:
+      * "sync"     — all replicas contribute every step (flag ≡ 1).
+      * "quorum"   — k-of-n backup-worker semantics: only the k fastest
+                     replicas (by modeled/measured step time) contribute
+                     (≙ tf.train.SyncReplicasOptimizer(replicas_to_aggregate=k),
+                     src/distributed_train.py:184-188).
+      * "timeout"  — deadline straggler drop: replicas whose step time
+                     exceeds ``timeout_ms`` are masked out (≙ the
+                     disabled RPC-kill path, src/timeout_manager.py:38-46).
+      * "interval" — wall-clock-paced windowed aggregation: gradients
+                     accumulate across steps and apply when the window
+                     elapses, averaging whatever arrived (take_grad(1)
+                     semantics, sync_replicas_optimizer_modified.py:208-215,371-373).
+      * "cdf"      — full barrier + per-replica step-time CDF collection
+                     (≙ --worker_times_cdf_method, TimeoutReplicasOptimizer
+                     take_grad(total), sync_replicas_optimizer_modified.py:370-376).
+    """
+
+    mode: str = "sync"
+    # -1 → all replicas, matching the reference default
+    # (src/distributed_train.py:118-121).
+    num_replicas_to_aggregate: int = -1
+    interval_ms: float = 1000.0  # ≙ FLAGS.interval_ms (sync_replicas_optimizer_modified.py:38)
+    timeout_ms: float = 1000.0
+    drop_connect: bool = False  # src/distributed_train.py:60
+    drop_connect_probability: float = 0.9  # keep-probability (:98-99)
+    # Synthetic per-replica straggler model for experiments on uniform
+    # TPU hardware (replaces the reference's method of inducing
+    # stragglers with slow EC2 instance types, cfg/time_cdf_cfgs/*).
+    straggler_profile: str = "none"  # none | lognormal | spike
+    straggler_mean_ms: float = 50.0
+    straggler_sigma: float = 0.5
+    straggler_spike_prob: float = 0.05
+    straggler_spike_scale: float = 10.0
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh topology. Replaces ClusterSpec/ps_hosts/worker_hosts
+    (src/mnist_distributed_train.py:25-31, src/distributed_train.py:41-48)."""
+
+    # -1 → use every visible device on the 'replica' axis.
+    num_replicas: int = -1
+    # Reserved axes so TP/SP can be added without redesign (SURVEY §5.7).
+    model_parallelism: int = 1
+    seq_parallelism: int = 1
+    replica_axis: str = "replica"
+    model_axis: str = "model"
+    seq_axis: str = "seq"
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Loop / checkpoint / logging cadences (≙ src/distributed_train.py:56-87)."""
+
+    max_steps: int = 1000
+    train_dir: str = "/tmp/dmt_train"
+    seed: int = 0
+    save_interval_steps: int = 200  # ≙ save_interval_secs=20 Supervisor autosave (:76)
+    save_interval_secs: float = 0.0  # optional wall-clock cadence; 0 = step-based
+    log_every_steps: int = 1  # reference logs every step (:365-371)
+    save_results_period: int = 1000  # ≙ FLAGS.save_results_period (:56-57)
+    summary_every_steps: int = 100  # ≙ save_summaries_secs (:78)
+    keep_checkpoints: int = 5
+    resume: bool = True  # ≙ Supervisor restore-if-present (:262)
+    profile_steps: tuple[int, int] = (0, 0)  # (start, stop) jax.profiler window
+
+
+@dataclass(frozen=True)
+class EvalConfig:
+    """Continuous evaluator (≙ src/nn_eval.py:36-45)."""
+
+    eval_interval_secs: float = 1.0
+    eval_dir: str = "/tmp/dmt_eval"
+    eval_batch_size: int = 0  # 0 → full test set in one batch (nn_eval.py:121-122)
+    run_once: bool = False
+    max_evals: int = 0  # 0 = unbounded
+
+
+@dataclass(frozen=True)
+class ExperimentConfig:
+    name: str = "default"
+    data: DataConfig = field(default_factory=DataConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    optim: OptimConfig = field(default_factory=OptimConfig)
+    sync: SyncConfig = field(default_factory=SyncConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    eval: EvalConfig = field(default_factory=EvalConfig)
+
+    # ---- construction helpers -------------------------------------------------
+
+    def replace(self, **sections: Any) -> "ExperimentConfig":
+        return dataclasses.replace(self, **sections)
+
+    def override(self, overrides: dict[str, Any]) -> "ExperimentConfig":
+        """Apply dotted-path overrides, e.g. {"sync.mode": "quorum"}."""
+        cfg = self
+        for path, value in overrides.items():
+            cfg = _set_path(cfg, path.split("."), value)
+        return cfg
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ExperimentConfig":
+        return _build(cls, dict(d))
+
+    @classmethod
+    def from_file(cls, path: str | Path) -> "ExperimentConfig":
+        """Load a config from JSON or a Python-literal file.
+
+        The reference ``eval()``s its cfg files (tools/benchmark.py:15) —
+        a known quirk we deliberately do not replicate (SURVEY §7):
+        literals only.
+        """
+        text = Path(path).read_text()
+        try:
+            d = json.loads(text)
+        except json.JSONDecodeError:
+            try:
+                d = ast.literal_eval(text)
+            except (ValueError, SyntaxError) as e:
+                raise ConfigError(f"{path}: not valid JSON or a Python literal: {e}")
+        if not isinstance(d, dict):
+            raise ConfigError(f"{path}: config must be a dict, got {type(d).__name__}")
+        return cls.from_dict(d)
+
+    def save(self, path: str | Path) -> None:
+        Path(path).write_text(json.dumps(self.to_dict(), indent=2, sort_keys=True))
+
+
+def _build(cls: type, d: dict[str, Any]) -> Any:
+    if not dataclasses.is_dataclass(cls):
+        return d
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for key, value in d.items():
+        if key not in fields:
+            raise ConfigError(f"unknown config key {key!r} for {cls.__name__}; "
+                              f"valid keys: {sorted(fields)}")
+        ftype = fields[key].type
+        sub = _SECTION_TYPES.get((cls.__name__, key))
+        if sub is not None and isinstance(value, dict):
+            kwargs[key] = _build(sub, value)
+        elif ftype in ("tuple[int, int]",) and isinstance(value, list):
+            kwargs[key] = tuple(value)
+        else:
+            kwargs[key] = value
+    return cls(**kwargs)
+
+
+_SECTION_TYPES = {
+    ("ExperimentConfig", "data"): DataConfig,
+    ("ExperimentConfig", "model"): ModelConfig,
+    ("ExperimentConfig", "optim"): OptimConfig,
+    ("ExperimentConfig", "sync"): SyncConfig,
+    ("ExperimentConfig", "mesh"): MeshConfig,
+    ("ExperimentConfig", "train"): TrainConfig,
+    ("ExperimentConfig", "eval"): EvalConfig,
+}
+
+
+def _set_path(obj: Any, path: list[str], value: Any) -> Any:
+    if not dataclasses.is_dataclass(obj):
+        raise ConfigError(f"cannot descend into non-config value at {'.'.join(path)}")
+    head, rest = path[0], path[1:]
+    fields = {f.name: f for f in dataclasses.fields(obj)}
+    if head not in fields:
+        raise ConfigError(f"unknown config key {head!r} on {type(obj).__name__}")
+    if rest:
+        new_child = _set_path(getattr(obj, head), rest, value)
+        return dataclasses.replace(obj, **{head: new_child})
+    current = getattr(obj, head)
+    if dataclasses.is_dataclass(current) and isinstance(value, dict):
+        # whole-section override: build the section dataclass, don't
+        # store a raw dict into the frozen config
+        value = _build(type(current), value)
+    elif current is not None and not isinstance(value, type(current)):
+        value = _coerce(value, type(current))
+    return dataclasses.replace(obj, **{head: value})
+
+
+def _coerce(value: Any, target: type) -> Any:
+    if target is bool:
+        if isinstance(value, str):
+            if value.lower() in ("true", "1", "yes"):
+                return True
+            if value.lower() in ("false", "0", "no"):
+                return False
+        return bool(value)
+    if target in (int, float, str):
+        return target(value)
+    if target is tuple and isinstance(value, (list, str)):
+        if isinstance(value, str):
+            value = ast.literal_eval(value)
+        return tuple(value)
+    return value
+
+
+def parse_cli_overrides(argv: list[str]) -> dict[str, Any]:
+    """Parse ``section.key=value`` CLI args (values literal-eval'd when possible)."""
+    out: dict[str, Any] = {}
+    for arg in argv:
+        if "=" not in arg:
+            raise ConfigError(f"override {arg!r} must look like section.key=value")
+        key, _, raw = arg.partition("=")
+        try:
+            out[key] = ast.literal_eval(raw)
+        except (ValueError, SyntaxError):
+            out[key] = raw
+    return out

@@ -1,0 +1,80 @@
+"""Structured logging.
+
+The reference logs through ``tf.logging`` and downstream tooling scrapes
+stdout with regexes (tools/benchmark.py:30,67,140,151). We keep the
+canonical human-readable per-step line — format-compatible with the
+reference's record at src/distributed_train.py:367-371 so its
+log-reading habits transfer — and *additionally* emit machine-readable
+JSONL so nothing downstream ever parses free text again.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from pathlib import Path
+from typing import Any, IO
+
+_LOGGER = logging.getLogger("distributedmnist_tpu")
+if not _LOGGER.handlers:
+    _h = logging.StreamHandler(sys.stderr)
+    _h.setFormatter(logging.Formatter("%(asctime)s %(levelname)s %(name)s] %(message)s"))
+    _LOGGER.addHandler(_h)
+    _LOGGER.setLevel(logging.INFO)
+    _LOGGER.propagate = False
+
+
+def get_logger(name: str | None = None) -> logging.Logger:
+    return _LOGGER if name is None else _LOGGER.getChild(name)
+
+
+class JsonlSink:
+    """Append-only JSONL event sink (one file per run/role)."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._fh: IO[str] = open(self.path, "a", buffering=1)
+
+    def write(self, record: dict[str, Any]) -> None:
+        record.setdefault("ts", time.time())
+        self._fh.write(json.dumps(record, default=_default) + "\n")
+
+    def close(self) -> None:
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _default(o: Any):
+    try:
+        import numpy as np
+        if isinstance(o, np.generic):
+            return o.item()
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+    except ImportError:
+        pass
+    return str(o)
+
+
+def step_line(replica: int, step: int, loss: float, train_acc: float,
+              examples_per_sec: float, sec_per_batch: float) -> str:
+    """The canonical per-step record (≙ src/distributed_train.py:367-371)."""
+    return ("Worker %d: step %d, loss = %.6f, train_acc = %.6f "
+            "(%.1f examples/sec; %.3f sec/batch)"
+            % (replica, step, loss, train_acc, examples_per_sec, sec_per_batch))
+
+
+def eval_line(num_examples: int, precision: float, loss: float, seconds: float) -> str:
+    """The evaluator's regex-parseable line — exact format of
+    src/nn_eval.py:102-103 so the reference's parser
+    (tools/benchmark.py:151) would still work."""
+    return ("Num examples: %d Precision @ 1: %f Loss: %f Time: %f"
+            % (num_examples, precision, loss, seconds))

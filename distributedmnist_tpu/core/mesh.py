@@ -1,0 +1,156 @@
+"""Device-mesh / topology discovery.
+
+Replaces the reference's cluster plumbing — ``tf.train.ClusterSpec`` +
+per-process ``tf.train.Server`` with explicit ps_hosts/worker_hosts
+strings (reference: src/mnist_distributed_train.py:25-31,
+src/distributed_train.py:41-48) and the EC2 role-assignment machinery
+(tools/tf_ec2.py:462-491) — with TPU-slice discovery: every host runs
+the same SPMD program, devices come from ``jax.devices()``, and the
+"cluster spec" is just a `jax.sharding.Mesh`.
+
+There is no parameter-server role: parameters are replicated and
+gradient aggregation is a compiler-scheduled psum over ICI (SURVEY §5.8).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .config import MeshConfig
+
+P = PartitionSpec
+
+
+def initialize_distributed() -> None:
+    """Multi-host bring-up (≙ tf.train.Server + startup barrier,
+    src/mnist_distributed_train.py:27-35, src/timeout_manager.py:198-211).
+
+    On a real multi-host TPU slice, `jax.distributed.initialize()`
+    discovers the coordinator (from TPU pod metadata, or the
+    JAX_COORDINATOR_ADDRESS / slurm env). MUST be called before
+    anything initializes the XLA backend, so this function touches no
+    other jax APIs first. A no-op when already initialized or when
+    nothing indicates a multi-host environment. Safe to call twice.
+    """
+    from jax._src import distributed as _dist
+    if _dist.global_state.client is not None:
+        return  # already initialized
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    multi_host_hint = (
+        os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+        or len([h for h in hostnames.split(",") if h]) > 1)
+    if not multi_host_hint:
+        return  # single-process run (one chip / CPU simulation)
+    jax.distributed.initialize()
+
+
+def simulate_devices(n: int) -> None:
+    """Force an ``n``-virtual-CPU-device platform. MUST run before the
+    XLA backend initializes — call from conftest/env setup.
+
+    This is the framework's answer to the reference's total lack of a
+    mock distributed backend (SURVEY §4): N-device SPMD semantics are
+    testable on one CPU host. The single point of truth for this idiom
+    (conftest and __graft_entry__ both route through it).
+
+    Note: some environments (this image's axon boot hook) re-register
+    an accelerator backend and override the JAX_PLATFORMS env var, so
+    the platform is forced via jax.config, not just env.
+    """
+    import re
+    flags = os.environ.get("XLA_FLAGS", "")
+    flag = f"--xla_force_host_platform_device_count={n}"
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", flag, flags)
+    else:
+        flags = (flags + " " + flag).strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    jax.config.update("jax_platforms", "cpu")
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Resolved topology: the mesh plus canonical shardings."""
+
+    mesh: Mesh
+    replica_axis: str
+    model_axis: str
+    seq_axis: str
+
+    @property
+    def num_replicas(self) -> int:
+        return self.mesh.shape[self.replica_axis]
+
+    @property
+    def replicated(self) -> NamedSharding:
+        """Sharding for parameters/state: replicated everywhere
+        (≙ vars pinned to the PS and read by all workers,
+        src/distributed_train.py:133-136 — except here every replica
+        holds the copy and XLA keeps them identical)."""
+        return NamedSharding(self.mesh, P())
+
+    @property
+    def batch_sharded(self) -> NamedSharding:
+        """Sharding for a global batch: leading dim split over replicas."""
+        return NamedSharding(self.mesh, P(self.replica_axis))
+
+    def device_put_batch(self, batch):
+        """Place a batch sharded over replicas.
+
+        Single-process: a plain device_put of the global batch.
+        Multi-host: each process holds only its local rows
+        (global_batch / process_count — see data.pipeline), so the
+        global array must be assembled from process-local shards.
+        """
+        if jax.process_count() > 1:
+            return jax.tree.map(
+                lambda x: jax.make_array_from_process_local_data(
+                    self.batch_sharded, np.asarray(x)),
+                batch)
+        return jax.device_put(batch, self.batch_sharded)
+
+    def device_put_replicated(self, tree):
+        return jax.device_put(tree, self.replicated)
+
+
+def make_topology(cfg: MeshConfig | None = None,
+                  devices: Sequence[jax.Device] | None = None) -> Topology:
+    """Build the device mesh.
+
+    Axes: (replica, model, seq). Data parallelism rides ``replica``;
+    ``model``/``seq`` are reserved for tensor/sequence parallelism and
+    default to size 1, so adding TP/SP later is a reshape, not a
+    redesign (SURVEY §5.7, §7).
+    """
+    cfg = cfg or MeshConfig()
+    devs = list(devices if devices is not None else jax.devices())
+    mp, sp = max(1, cfg.model_parallelism), max(1, cfg.seq_parallelism)
+    n = cfg.num_replicas
+    if n == -1:
+        n = len(devs) // (mp * sp)
+    want = n * mp * sp
+    if want > len(devs):
+        raise ValueError(
+            f"mesh needs {want} devices (replica={n} × model={mp} × seq={sp}) "
+            f"but only {len(devs)} are visible")
+    grid = np.array(devs[:want]).reshape(n, mp, sp)
+    mesh = Mesh(grid, (cfg.replica_axis, cfg.model_axis, cfg.seq_axis))
+    return Topology(mesh=mesh,
+                    replica_axis=cfg.replica_axis,
+                    model_axis=cfg.model_axis,
+                    seq_axis=cfg.seq_axis)
+
+
+def make_seq_topology(n_seq: int, devices: Sequence[jax.Device] | None = None) -> Topology:
+    """A mesh that spends its devices on the sequence axis (ring
+    attention / context parallelism — the long-context path)."""
+    return make_topology(
+        MeshConfig(num_replicas=1, seq_parallelism=n_seq), devices=devices)

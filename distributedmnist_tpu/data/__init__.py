@@ -1,0 +1,9 @@
+from .datasets import (ArrayDataset, Datasets, load_cifar10, load_datasets,
+                       load_idx_dataset, make_synthetic)
+from .pipeline import BatchIterator, eval_batches, make_train_iterator
+
+__all__ = [
+    "ArrayDataset", "Datasets", "load_cifar10", "load_datasets",
+    "load_idx_dataset", "make_synthetic", "BatchIterator", "eval_batches",
+    "make_train_iterator",
+]

@@ -1,0 +1,137 @@
+"""Batching pipeline: deterministic per-epoch reshuffle, host sharding,
+eval padding, and (optional) native C++ prefetch.
+
+≙ ``DataSet.next_batch`` — which reshuffles per epoch with a *time*
+seed (src/mnist_data.py:55,80-84,102-130). Here the shuffle stream is
+seeded (replayable) and epoch-indexed; under ``shard_mode="sharded"``
+each host iterates only its slice, under ``"independent"`` each host
+iterates its own full-data shuffle (the reference's faithful mode).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+from ..core.config import DataConfig
+from .datasets import ArrayDataset
+
+
+class BatchIterator:
+    """Infinite epoch-reshuffling batch stream over an ArrayDataset.
+
+    Yields numpy dicts {"image": [b, ...], "label": [b]} where ``b`` is
+    the *host-local* batch (global batch / process_count).
+    """
+
+    def __init__(self, data: ArrayDataset, batch_size: int, seed: int,
+                 host_id: int = 0, num_hosts: int = 1,
+                 shard_mode: str = "sharded", drop_remainder: bool = True):
+        if batch_size % num_hosts != 0:
+            raise ValueError(f"global batch {batch_size} not divisible by "
+                             f"{num_hosts} hosts")
+        self.local_batch = batch_size // num_hosts
+        if shard_mode == "sharded":
+            self.data = data.shard(host_id, num_hosts) if num_hosts > 1 else data
+            self.seed = seed  # same shuffle stream, disjoint data
+        elif shard_mode == "independent":
+            self.data = data  # full copy per host, host-distinct stream
+            self.seed = seed * 1_000_003 + host_id
+        else:
+            raise ValueError(f"unknown shard_mode {shard_mode!r}")
+        if self.data.num_examples < self.local_batch:
+            raise ValueError(
+                f"host-local dataset ({self.data.num_examples}) smaller than "
+                f"host-local batch ({self.local_batch})")
+        self.drop_remainder = drop_remainder
+        self._epoch = 0
+        self._pos = 0
+        self._order = self._epoch_order(0)
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        rng = np.random.default_rng((self.seed, epoch))
+        return rng.permutation(self.data.num_examples)
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def __iter__(self) -> Iterator[dict]:
+        return self
+
+    def __next__(self) -> dict:
+        n = self.data.num_examples
+        if self._pos + self.local_batch > n:
+            # drop the ragged tail and reshuffle (≙ src/mnist_data.py:113-125)
+            self._epoch += 1
+            self._order = self._epoch_order(self._epoch)
+            self._pos = 0
+        idx = self._order[self._pos:self._pos + self.local_batch]
+        self._pos += self.local_batch
+        return {"image": self.data.images[idx], "label": self.data.labels[idx]}
+
+    def state(self) -> dict:
+        """Checkpointable position (the reference cannot resume its
+        data stream; we can)."""
+        return {"epoch": self._epoch, "pos": self._pos}
+
+    def restore(self, state: dict) -> None:
+        self._epoch = int(state["epoch"])
+        self._order = self._epoch_order(self._epoch)
+        self._pos = int(state["pos"])
+
+
+def eval_batches(data: ArrayDataset, batch_size: int, pad_multiple: int = 1,
+                 host_id: int = 0, num_hosts: int = 1) -> Iterator[dict]:
+    """Fixed-order eval batches with 0/1 weights; batches are
+    zero-padded to full size so shapes stay static under jit (the
+    reference instead builds a graph at batch = full test-set size,
+    src/nn_eval.py:121-122 — static shapes are the TPU-native answer).
+
+    Multi-host: ``data`` is the full split on every host; each host
+    yields only its strided stripe (so psum'd weights count every
+    example exactly once), and the number of batches is computed from
+    the *global* size so all hosts stay in lockstep.
+    """
+    global_n = data.num_examples
+    if batch_size <= 0:
+        batch_size = global_n
+    if batch_size % num_hosts != 0:
+        batch_size += num_hosts - batch_size % num_hosts
+    local_bs = batch_size // num_hosts
+    if local_bs % pad_multiple != 0:
+        local_bs += pad_multiple - local_bs % pad_multiple
+    stripe = data.shard(host_id, num_hosts) if num_hosts > 1 else data
+    max_stripe = -(-global_n // num_hosts)  # ceil: the largest stripe
+    num_batches = max(1, -(-max_stripe // local_bs))
+    for b in range(num_batches):
+        start = b * local_bs
+        stop = min(start + local_bs, stripe.num_examples)
+        take = max(stop - start, 0)
+        x = stripe.images[start:start + take]
+        y = stripe.labels[start:start + take]
+        w = np.ones(take, np.float32)
+        pad = local_bs - take
+        if pad:
+            x = np.concatenate([x, np.zeros((pad,) + data.images.shape[1:],
+                                            data.images.dtype)])
+            y = np.concatenate([y, np.zeros(pad, data.labels.dtype)])
+            w = np.concatenate([w, np.zeros(pad, np.float32)])
+        yield {"image": x, "label": y, "weight": w}
+
+
+def make_train_iterator(data: ArrayDataset, cfg: DataConfig, seed: int,
+                        host_id: int = 0, num_hosts: int = 1) -> BatchIterator:
+    it = BatchIterator(data, cfg.batch_size, seed=seed, host_id=host_id,
+                       num_hosts=num_hosts, shard_mode=cfg.shard_mode)
+    if cfg.use_native_pipeline:
+        from ..core.log import get_logger
+        try:
+            from .native_loader import NativePrefetcher
+        except ImportError as e:
+            get_logger("data").warning(
+                "native pipeline unavailable (%s); using pure-python batching", e)
+        else:
+            return NativePrefetcher(it, depth=cfg.prefetch_batches)  # type: ignore[return-value]
+    return it

@@ -1,0 +1,31 @@
+"""CLI: ``python -m distributedmnist_tpu.evalsvc --train_dir ... [overrides]``
+
+≙ the evaluator binary (src/mnist_eval.py) the EC2 launcher starts on
+its evaluator node (tools/tf_ec2.py:130-146).
+"""
+
+import argparse
+
+from ..core.config import EvalConfig
+from .evaluator import Evaluator
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="continuous checkpoint evaluator")
+    p.add_argument("--train_dir", required=True)
+    p.add_argument("--eval_dir", default="/tmp/dmt_eval")
+    p.add_argument("--eval_interval_secs", type=float, default=1.0)
+    p.add_argument("--eval_batch_size", type=int, default=0)
+    p.add_argument("--run_once", action="store_true")
+    p.add_argument("--max_evals", type=int, default=0)
+    args = p.parse_args(argv)
+
+    ecfg = EvalConfig(eval_interval_secs=args.eval_interval_secs,
+                      eval_dir=args.eval_dir,
+                      eval_batch_size=args.eval_batch_size,
+                      run_once=args.run_once, max_evals=args.max_evals)
+    Evaluator(args.train_dir, ecfg).run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,141 @@
+"""Continuous checkpoint evaluator.
+
+≙ the reference's dedicated evaluator process (src/mnist_eval.py,
+src/nn_eval.py): poll the trainer's checkpoint directory, restore the
+newest checkpoint, skip if the step hasn't advanced
+(src/nn_eval.py:84-88), measure full-test-set accuracy+loss, emit the
+regex-parseable line (src/nn_eval.py:102-103) plus structured JSONL.
+
+Differences from the reference:
+* The model/config is read from the checkpoint's own saved config — no
+  risk of evaluator/trainer graph skew (the reference rebuilds the
+  graph from whatever flags the evaluator was launched with).
+* Eval batches are static-shaped and weight-padded instead of building
+  a graph at batch = full-test-set size (src/nn_eval.py:121-122).
+* The checkpoint pointer read is atomic (no torn reads off NFS).
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+
+import jax
+
+from ..core.config import EvalConfig, ExperimentConfig
+from ..core.log import JsonlSink, eval_line, get_logger
+from ..core.mesh import Topology, make_topology
+from ..data.datasets import Datasets, load_datasets
+from ..data.pipeline import eval_batches
+from ..models.registry import get_model
+from ..parallel.api import build_eval_step, init_train_state
+from ..train import checkpoint as ckpt
+
+logger = get_logger("eval")
+
+
+class Evaluator:
+    """Polls ``train_dir`` and evaluates each new checkpoint once."""
+
+    def __init__(self, train_dir: str | Path, eval_cfg: EvalConfig | None = None,
+                 cfg: ExperimentConfig | None = None,
+                 topo: Topology | None = None,
+                 datasets: Datasets | None = None):
+        self.train_dir = Path(train_dir)
+        self.eval_cfg = eval_cfg or EvalConfig()
+        if cfg is None:
+            cfg = self._config_from_checkpoint()
+        self.cfg = cfg
+        self.topo = topo or make_topology(cfg.mesh)
+        self.model = get_model(cfg.model)
+        self.datasets = datasets if datasets is not None else load_datasets(
+            cfg.data, cfg.model.image_size, cfg.model.num_channels,
+            cfg.model.num_classes)
+        self.eval_fn = build_eval_step(self.model, cfg, self.topo)
+        self.template = init_train_state(self.model, cfg)
+        self.last_step_evaluated = -1
+        self._sink: JsonlSink | None = None
+
+    def _config_from_checkpoint(self) -> ExperimentConfig:
+        """Wait for the first checkpoint, then adopt its saved config."""
+        deadline = time.time() + 600.0
+        while time.time() < deadline:
+            step = ckpt.latest_checkpoint_step(self.train_dir)
+            if step is not None:
+                from ..models.registry import get_model as _gm
+                from ..core.config import ExperimentConfig as EC
+                probe_cfg = EC()
+                template = init_train_state(_gm(probe_cfg.model), probe_cfg)
+                try:
+                    _, extra, _ = ckpt.restore_checkpoint(self.train_dir, template, step)
+                    if "config" in extra:
+                        return EC.from_dict(extra["config"])
+                except Exception:  # template mismatch — config still readable?
+                    pass
+                logger.warning("checkpoint has no saved config; using defaults")
+                return EC()
+            time.sleep(1.0)
+        raise TimeoutError(f"no checkpoint appeared in {self.train_dir} within 600s")
+
+    # ------------------------------------------------------------------
+
+    def evaluate_checkpoint(self, step: int | None = None) -> dict | None:
+        """Evaluate one checkpoint (≙ do_eval, src/nn_eval.py:49-115)."""
+        restored = ckpt.restore_checkpoint(self.train_dir, self.template, step)
+        if restored is None:
+            return None
+        state, _, at_step = restored
+        params = self.topo.device_put_replicated(state.params)
+        data = self.datasets.test
+        n = self.topo.num_replicas
+        hosts = jax.process_count()
+        bs = self.eval_cfg.eval_batch_size or max(n, min(4096, data.num_examples))
+        t0 = time.time()
+        correct = loss_sum = weight = 0.0
+        for batch in eval_batches(data, bs, pad_multiple=max(1, n // hosts),
+                                  host_id=jax.process_index(), num_hosts=hosts):
+            c, l, w = self.eval_fn(params, self.topo.device_put_batch(batch))
+            correct += float(c)
+            loss_sum += float(l)
+            weight += float(w)
+        dt = time.time() - t0
+        result = {
+            "event": "eval", "step": at_step,
+            "num_examples": int(weight),
+            "precision_at_1": correct / max(weight, 1.0),
+            "loss": loss_sum / max(weight, 1.0),
+            "seconds": dt,
+        }
+        # the reference's exact parseable line (src/nn_eval.py:102-103)
+        print(eval_line(result["num_examples"], result["precision_at_1"],
+                        result["loss"], dt), flush=True)
+        if self._sink:
+            self._sink.write(result)
+        return result
+
+    def run(self) -> list[dict]:
+        """Poll loop (≙ evaluate(), src/nn_eval.py:117-140)."""
+        ecfg = self.eval_cfg
+        eval_dir = Path(ecfg.eval_dir)
+        eval_dir.mkdir(parents=True, exist_ok=True)
+        self._sink = JsonlSink(eval_dir / "eval_log.jsonl")
+        results: list[dict] = []
+        try:
+            while True:
+                step = ckpt.latest_checkpoint_step(self.train_dir)
+                if step is not None and step != self.last_step_evaluated:
+                    out = self.evaluate_checkpoint(step)
+                    if out is not None:
+                        self.last_step_evaluated = step
+                        results.append(out)
+                elif step is None:
+                    logger.info("no checkpoint yet in %s", self.train_dir)
+                if ecfg.run_once and results:
+                    break
+                if ecfg.max_evals and len(results) >= ecfg.max_evals:
+                    break
+                time.sleep(ecfg.eval_interval_secs)
+        finally:
+            self._sink.close()
+            self._sink = None
+        return results

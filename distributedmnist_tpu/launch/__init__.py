@@ -1,0 +1,3 @@
+from .sweep import load_sweep_configs, run_experiment, run_sweep, write_report
+
+__all__ = ["load_sweep_configs", "run_experiment", "run_sweep", "write_report"]

@@ -1,0 +1,109 @@
+"""Framework CLI.
+
+≙ the reference's launch surface: ``tools/tf_ec2.py``'s subcommand
+dispatch (:828-867) and the templated per-role SSH commands it
+generated (:109-146). On TPU there are no roles to template — every
+host runs the same program — so the CLI reduces to:
+
+  python -m distributedmnist_tpu.launch train --config cfg.json [k=v ...]
+  python -m distributedmnist_tpu.launch eval  --train_dir DIR
+  python -m distributedmnist_tpu.launch sweep --configs DIR --results DIR
+  python -m distributedmnist_tpu.launch devices
+
+Dotted overrides (``sync.mode=quorum``) take the place of the ~25
+tf.app.flags (src/distributed_train.py:36-99).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _train(args) -> None:
+    from ..core.config import ExperimentConfig, parse_cli_overrides
+    from ..core.mesh import initialize_distributed
+    initialize_distributed()  # multi-host bring-up before backend init
+    from ..train.loop import Trainer
+
+    cfg = (ExperimentConfig.from_file(args.config) if args.config
+           else ExperimentConfig())
+    cfg = cfg.override(parse_cli_overrides(args.overrides))
+    trainer = Trainer(cfg)
+    summary = trainer.run()
+    result = trainer.evaluate("test")
+    print(json.dumps({"summary": {k: v for k, v in summary.items() if k != "timing"},
+                      "test": result}, default=str))
+
+
+def _eval(args) -> None:
+    from ..core.config import EvalConfig
+    from .. import evalsvc
+
+    ecfg = EvalConfig(eval_interval_secs=args.eval_interval_secs,
+                      eval_dir=args.eval_dir, run_once=args.run_once,
+                      max_evals=args.max_evals)
+    evalsvc.Evaluator(args.train_dir, ecfg).run()
+
+
+def _sweep(args) -> None:
+    from ..core.mesh import initialize_distributed
+    initialize_distributed()
+    from .sweep import load_sweep_configs, run_sweep
+
+    cfgs = load_sweep_configs(args.configs)
+    if args.only:
+        cfgs = [c for c in cfgs if c.name in set(args.only.split(","))]
+    records = run_sweep(cfgs, args.results)
+    print(json.dumps([{k: r[k] for k in ("name", "test_accuracy",
+                                         "examples_per_sec")}
+                      for r in records]))
+
+
+def _devices(_args) -> None:
+    """≙ list_running_instances (tools/tf_ec2.py:371-402) — but the
+    'cluster' is whatever mesh JAX sees."""
+    import jax
+    info = {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "devices": [{"id": d.id, "platform": d.platform,
+                     "kind": getattr(d, "device_kind", "?")}
+                    for d in jax.devices()],
+    }
+    print(json.dumps(info, indent=2))
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="distributedmnist_tpu.launch")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pt = sub.add_parser("train", help="run a training experiment")
+    pt.add_argument("--config", default=None)
+    pt.add_argument("overrides", nargs="*", help="dotted overrides k=v")
+    pt.set_defaults(fn=_train)
+
+    pe = sub.add_parser("eval", help="continuous evaluator")
+    pe.add_argument("--train_dir", required=True)
+    pe.add_argument("--eval_dir", default="/tmp/dmt_eval")
+    pe.add_argument("--eval_interval_secs", type=float, default=1.0)
+    pe.add_argument("--run_once", action="store_true")
+    pe.add_argument("--max_evals", type=int, default=0)
+    pe.set_defaults(fn=_eval)
+
+    ps = sub.add_parser("sweep", help="run a directory of experiment configs")
+    ps.add_argument("--configs", required=True)
+    ps.add_argument("--results", required=True)
+    ps.add_argument("--only", default=None, help="comma-separated names")
+    ps.set_defaults(fn=_sweep)
+
+    pd = sub.add_parser("devices", help="show mesh topology")
+    pd.set_defaults(fn=_devices)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

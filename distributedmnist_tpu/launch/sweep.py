@@ -1,0 +1,151 @@
+"""Experiment sweep runner + report generation.
+
+≙ the reference's benchmark harness (tools/benchmark.py): it launched
+EC2 clusters per cfg file, polled the master's stdout with a regex
+until step N, SCP'd logs home, re-parsed them, and plotted
+(tools/benchmark.py:17-58,265-292). Here an experiment is an
+ExperimentConfig, runs are in-process (or one SPMD program over a
+slice), metrics are structured from the start, and the "download +
+regex" stage does not exist.
+
+A sweep directory of config files (JSON / python literals — the safe
+replacement for the reference's eval()'d cfg/, SURVEY §5.6) maps to
+the reference's ``cfg/50_workers`` and ``cfg/time_cdf_cfgs`` grids.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any, Iterable
+
+from ..core.config import ExperimentConfig
+from ..core.log import JsonlSink, get_logger
+
+logger = get_logger("sweep")
+
+
+def run_experiment(cfg: ExperimentConfig, results_dir: str | Path,
+                   datasets=None) -> dict[str, Any]:
+    """Run one experiment to max_steps; return (and persist) a result
+    record: final metrics, eval accuracy, step-time CDF stats.
+
+    ≙ run_tf_and_download_files + stats parsing
+    (tools/benchmark.py:36-163) collapsed into a function call.
+    """
+    from ..train.loop import Trainer  # deferred: heavy jax import chain
+
+    results_dir = Path(results_dir) / cfg.name
+    results_dir.mkdir(parents=True, exist_ok=True)
+    cfg = cfg.override({"train.train_dir": str(results_dir / "train")})
+    cfg.save(results_dir / "config.json")
+
+    t0 = time.time()
+    trainer = Trainer(cfg, datasets=datasets)
+    summary = trainer.run()
+    wall = time.time() - t0
+    final_eval = trainer.evaluate("test")
+
+    record = {
+        "name": cfg.name,
+        "mode": cfg.sync.mode,
+        "num_replicas": trainer.topo.num_replicas,
+        "aggregate_k": cfg.sync.num_replicas_to_aggregate,
+        "interval_ms": cfg.sync.interval_ms,
+        "straggler_profile": cfg.sync.straggler_profile,
+        "steps": summary["final_step"],
+        "updates_applied": summary["updates_applied"],
+        "wall_seconds": wall,
+        "examples_per_sec": summary["last_metrics"].get("examples_per_sec"),
+        "final_loss": summary["last_metrics"].get("loss"),
+        "final_train_acc": summary["last_metrics"].get("train_acc"),
+        "test_accuracy": final_eval["accuracy"],
+        "test_loss": final_eval["loss"],
+        "timing": summary["timing"],
+    }
+    (results_dir / "result.json").write_text(json.dumps(record, indent=2))
+    logger.info("experiment %s: test_acc=%.4f, %.1f ex/s, p99 barrier=%.3fms",
+                cfg.name, record["test_accuracy"],
+                record["examples_per_sec"] or -1,
+                record["timing"]["barrier"].get("p99", float("nan")))
+    return record
+
+
+def load_sweep_configs(path: str | Path) -> list[ExperimentConfig]:
+    """Load every config file in a sweep directory (sorted), or a
+    single file (≙ benchmark.py use_dir/select_files, :281-292)."""
+    path = Path(path)
+    files = ([path] if path.is_file() else
+             sorted(p for p in path.iterdir()
+                    if p.suffix in (".json", ".cfg", ".py") and p.is_file()))
+    cfgs = [ExperimentConfig.from_file(f) for f in files]
+    names = [c.name for c in cfgs]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate experiment names in sweep: {names}")
+    return cfgs
+
+
+def run_sweep(configs: Iterable[ExperimentConfig], results_dir: str | Path,
+              datasets=None) -> list[dict[str, Any]]:
+    """≙ plot_figs' experiment loop (tools/benchmark.py:265-279)."""
+    results_dir = Path(results_dir)
+    results_dir.mkdir(parents=True, exist_ok=True)
+    records = []
+    with JsonlSink(results_dir / "sweep_results.jsonl") as sink:
+        for cfg in configs:
+            rec = run_experiment(cfg, results_dir, datasets=datasets)
+            sink.write(rec)
+            records.append(rec)
+    write_report(records, results_dir)
+    return records
+
+
+def write_report(records: list[dict[str, Any]], results_dir: str | Path) -> Path:
+    """Markdown summary table + optional CDF/convergence plots
+    (≙ the matplotlib figures, tools/benchmark.py:165-263)."""
+    results_dir = Path(results_dir)
+    lines = [
+        "# Sweep report", "",
+        "| name | mode | k | steps | updates | test acc | ex/s | "
+        "barrier p50 (ms) | barrier p99 (ms) |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in records:
+        b = r["timing"]["barrier"]
+        lines.append(
+            f"| {r['name']} | {r['mode']} | {r['aggregate_k']} | {r['steps']} "
+            f"| {r['updates_applied']} | {r['test_accuracy']:.4f} "
+            f"| {r['examples_per_sec'] or 0:.0f} | {b.get('p50', 0):.3f} "
+            f"| {b.get('p99', 0):.3f} |")
+    report = results_dir / "report.md"
+    report.write_text("\n".join(lines) + "\n")
+    try:
+        _plot(records, results_dir)
+    except Exception as e:  # plotting is best-effort, never fails a sweep
+        logger.warning("plotting skipped: %s", e)
+    return report
+
+
+def _plot(records: list[dict[str, Any]], results_dir: Path) -> None:
+    """Step-time CDFs per experiment (≙ the per-worker CDF figure,
+    tools/benchmark.py:226-263)."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import numpy as np
+
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    for r in records:
+        per_replica = r["timing"]["per_replica"]
+        if not per_replica:
+            continue
+        means = sorted(s["mean"] for s in per_replica)
+        ys = np.arange(1, len(means) + 1) / len(means)
+        ax.step(means, ys, where="post", label=r["name"])
+    ax.set_xlabel("mean per-replica step time (ms)")
+    ax.set_ylabel("CDF over replicas")
+    ax.legend(fontsize=7)
+    fig.tight_layout()
+    fig.savefig(results_dir / "step_time_cdf.png", dpi=120)
+    plt.close(fig)

@@ -1,0 +1,3 @@
+from .registry import Model, available, get_model, register
+
+__all__ = ["Model", "available", "get_model", "register"]

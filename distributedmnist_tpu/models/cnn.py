@@ -1,0 +1,100 @@
+"""LeNet-style MNIST CNN as a pure-function param pytree.
+
+Capability parity with the reference model (src/mnist.py:76-167):
+conv5x5x32 → ReLU → maxpool2 → conv5x5x64 → ReLU → maxpool2 → FC512 →
+dropout(0.5, train only) → FC10; truncated-normal(stddev=0.1) weight
+init with fixed seed 66478 (src/mnist.py:32,81-101); zero bias on
+conv1, 0.1 bias elsewhere; mean sparse-softmax-xent loss
+(src/mnist.py:149-159); top-1 accuracy (src/mnist.py:161-164).
+
+TPU-first differences from the reference:
+* NHWC convs lowered by XLA:TPU to MXU-tiled HLO (no cuDNN).
+* Activations/matmuls run in ``compute_dtype`` (bfloat16 by default)
+  while params and the loss stay float32 — the MXU's native mode.
+* Dropout consumes an explicit PRNG key (no hidden graph seed state).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = dict[str, Any]
+
+
+def truncated_normal_init(key: jax.Array, shape: tuple[int, ...],
+                          stddev: float = 0.1, dtype=jnp.float32) -> jax.Array:
+    """TF-style truncated normal: N(0, stddev²) truncated to ±2σ
+    (≙ tf.truncated_normal, src/mnist.py:81-99)."""
+    return jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype) * stddev
+
+
+def init(key: jax.Array, image_size: int = 28, num_channels: int = 1,
+         num_classes: int = 10) -> Params:
+    """Initialize parameters (init constants per src/mnist.py:81-101)."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    fc_in = (image_size // 4) * (image_size // 4) * 64
+    return {
+        "conv1": {"w": truncated_normal_init(k1, (5, 5, num_channels, 32)),
+                  "b": jnp.zeros((32,), jnp.float32)},
+        "conv2": {"w": truncated_normal_init(k2, (5, 5, 32, 64)),
+                  "b": jnp.full((64,), 0.1, jnp.float32)},
+        "fc1": {"w": truncated_normal_init(k3, (fc_in, 512)),
+                "b": jnp.full((512,), 0.1, jnp.float32)},
+        "fc2": {"w": truncated_normal_init(k4, (512, num_classes)),
+                "b": jnp.full((num_classes,), 0.1, jnp.float32)},
+    }
+
+
+def _conv2d_same(x: jax.Array, w: jax.Array) -> jax.Array:
+    return lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _maxpool2(x: jax.Array) -> jax.Array:
+    return lax.reduce_window(x, -jnp.inf, lax.max,
+                             window_dimensions=(1, 2, 2, 1),
+                             window_strides=(1, 2, 2, 1),
+                             padding="SAME")
+
+
+def apply(params: Params, images: jax.Array, *, train: bool = False,
+          dropout_key: jax.Array | None = None, dropout_rate: float = 0.5,
+          compute_dtype=jnp.bfloat16) -> jax.Array:
+    """Forward pass → float32 logits [batch, num_classes].
+
+    ``images``: [batch, H, W, C] floats normalized to [-0.5, 0.5]
+    (normalization parity: src/mnist_data.py:142).
+    """
+    x = images.astype(compute_dtype)
+    p = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+
+    x = _maxpool2(jax.nn.relu(_conv2d_same(x, p["conv1"]["w"]) + p["conv1"]["b"]))
+    x = _maxpool2(jax.nn.relu(_conv2d_same(x, p["conv2"]["w"]) + p["conv2"]["b"]))
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ p["fc1"]["w"] + p["fc1"]["b"])
+    if train and dropout_rate > 0.0:
+        if dropout_key is None:
+            raise ValueError("train=True dropout requires dropout_key")
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_rate, x.shape)
+        # Inverted dropout — same "no rescale at eval" semantics as
+        # tf.nn.dropout (src/mnist.py:137-140).
+        x = jnp.where(keep, x / (1.0 - dropout_rate), 0.0).astype(compute_dtype)
+    logits = x @ p["fc2"]["w"] + p["fc2"]["b"]
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean sparse softmax cross-entropy (≙ src/mnist.py:149-159)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    return jnp.mean(nll)
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Top-1 accuracy (≙ src/mnist.py:161-164)."""
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))

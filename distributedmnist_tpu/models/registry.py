@@ -1,0 +1,149 @@
+"""Model registry: every model is a pure (init, apply, loss, accuracy)
+bundle over a param pytree — no classes, no hidden state, trivially
+compatible with jit/grad/shard_map.
+
+Replaces the reference's single hardwired model module
+(src/mnist.py, wired at src/distributed_train.py:158-171) with a
+family registry covering the BASELINE.json configs (MNIST CNN,
+Fashion-MNIST CNN, CIFAR-10 ResNet-20, plus a transformer for the
+long-context path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..core.config import ModelConfig
+
+
+def classification_eval_metrics(logits: jax.Array, labels: jax.Array,
+                                weight: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-batch weighted eval sums for a [batch, classes] classifier:
+    (correct_sum, loss_sum, weight_sum). Padded examples carry weight 0
+    so they never bias metrics."""
+    w = weight.astype(jnp.float32)
+    correct = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32),
+                               axis=-1)[:, 0]
+    return jnp.sum(correct * w), jnp.sum(nll * w), jnp.sum(w)
+
+
+def lm_eval_metrics(logits: jax.Array, labels: jax.Array,
+                    weight: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Token-level eval sums for a [batch, seq, vocab] causal LM
+    (weight is per-sequence; counts are per predicted token)."""
+    w = weight.astype(jnp.float32)[:, None]
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    tgt = labels[:, 1:].astype(jnp.int32)
+    correct = (jnp.argmax(logp, axis=-1) == tgt).astype(jnp.float32)
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return (jnp.sum(correct * w), jnp.sum(nll * w),
+            jnp.sum(w * jnp.ones_like(correct)))
+
+
+@dataclasses.dataclass(frozen=True)
+class Model:
+    """A model family instance.
+
+    * ``init(key) -> params``
+    * ``apply(params, inputs, train=..., dropout_key=...) -> logits``
+    * ``loss(logits, labels) -> scalar``
+    * ``accuracy(logits, labels) -> scalar``
+    * ``eval_metrics(logits, labels, weight) -> (correct_sum, loss_sum, weight_sum)``
+    * ``input_shape`` excludes the batch dim.
+    """
+
+    name: str
+    init: Callable[[jax.Array], Any]
+    apply: Callable[..., jax.Array]
+    loss: Callable[[jax.Array, jax.Array], jax.Array]
+    accuracy: Callable[[jax.Array, jax.Array], jax.Array]
+    input_shape: tuple[int, ...]
+    input_dtype: Any = jnp.float32
+    eval_metrics: Callable[..., tuple] = classification_eval_metrics
+
+
+_REGISTRY: dict[str, Callable[[ModelConfig], Model]] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def available() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def get_model(cfg: ModelConfig) -> Model:
+    if cfg.name not in _REGISTRY:
+        raise ValueError(f"unknown model {cfg.name!r}; available: {available()}")
+    return _REGISTRY[cfg.name](cfg)
+
+
+@register("mnist_cnn")
+def _mnist_cnn(cfg: ModelConfig) -> Model:
+    from . import cnn
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+
+    def init(key):
+        return cnn.init(key, image_size=cfg.image_size,
+                        num_channels=cfg.num_channels,
+                        num_classes=cfg.num_classes)
+
+    def apply(params, x, *, train=False, dropout_key=None):
+        return cnn.apply(params, x, train=train, dropout_key=dropout_key,
+                         dropout_rate=cfg.dropout_rate,
+                         compute_dtype=compute_dtype)
+
+    return Model(name=cfg.name, init=init, apply=apply,
+                 loss=cnn.loss_fn, accuracy=cnn.accuracy,
+                 input_shape=(cfg.image_size, cfg.image_size, cfg.num_channels))
+
+
+@register("resnet20")
+def _resnet20(cfg: ModelConfig) -> Model:
+    from . import resnet
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+
+    def init(key):
+        return resnet.init(key, num_classes=cfg.num_classes,
+                           num_channels=cfg.num_channels)
+
+    def apply(params, x, *, train=False, dropout_key=None):
+        del dropout_key  # resnet20 has no dropout
+        return resnet.apply(params, x, train=train, compute_dtype=compute_dtype)
+
+    from . import cnn
+    return Model(name=cfg.name, init=init, apply=apply,
+                 loss=cnn.loss_fn, accuracy=cnn.accuracy,
+                 input_shape=(cfg.image_size, cfg.image_size, cfg.num_channels))
+
+
+@register("transformer")
+def _transformer(cfg: ModelConfig) -> Model:
+    from . import transformer
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+
+    def init(key):
+        return transformer.init(
+            key, vocab_size=cfg.vocab_size, model_dim=cfg.model_dim,
+            num_heads=cfg.num_heads, num_layers=cfg.num_layers,
+            max_seq_len=cfg.seq_len)
+
+    def apply(params, x, *, train=False, dropout_key=None):
+        del dropout_key
+        return transformer.apply(params, x, num_heads=cfg.num_heads,
+                                 compute_dtype=compute_dtype)
+
+    return Model(name=cfg.name, init=init, apply=apply,
+                 loss=transformer.loss_fn, accuracy=transformer.accuracy,
+                 input_shape=(cfg.seq_len,), input_dtype=jnp.int32,
+                 eval_metrics=lm_eval_metrics)

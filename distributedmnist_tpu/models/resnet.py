@@ -1,0 +1,110 @@
+"""ResNet-20 (CIFAR-10) — the stress-config model (BASELINE.json
+configs[4]: "CIFAR-10 ResNet-20 sync-replicas allreduce payload").
+
+The reference has no second model family (src/mnist.py is its only
+model); this exists to exercise the aggregation path with a ~0.27M-
+param allreduce payload and real residual/normalization structure.
+
+TPU-first choices:
+* NHWC convs → MXU-tiled XLA HLO; compute in bfloat16, params float32.
+* GroupNorm instead of BatchNorm: no running-stats state to
+  synchronize across replicas, so the model stays a pure function and
+  the train step needs no side state — and accuracy parity for CIFAR
+  at this scale is well established.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .cnn import truncated_normal_init
+
+Params = dict[str, Any]
+
+WIDTHS = (16, 32, 64)
+BLOCKS_PER_STAGE = 3  # 3 stages × 3 blocks × 2 convs + stem + head = 20 layers
+
+
+def _conv_init(key: jax.Array, shape: tuple[int, ...]) -> jax.Array:
+    # He-style fan-out scaling, truncated
+    fan_out = shape[0] * shape[1] * shape[3]
+    stddev = jnp.sqrt(2.0 / fan_out)
+    return truncated_normal_init(key, shape, stddev=float(stddev))
+
+
+def init(key: jax.Array, num_classes: int = 10, num_channels: int = 3) -> Params:
+    keys = iter(jax.random.split(key, 64))
+    params: Params = {
+        "stem": {"w": _conv_init(next(keys), (3, 3, num_channels, WIDTHS[0]))},
+        "stem_norm": _norm_init(WIDTHS[0]),
+        "stages": [],
+    }
+    in_ch = WIDTHS[0]
+    for width in WIDTHS:
+        stage = []
+        for b in range(BLOCKS_PER_STAGE):
+            block = {
+                "conv1": {"w": _conv_init(next(keys), (3, 3, in_ch, width))},
+                "norm1": _norm_init(width),
+                "conv2": {"w": _conv_init(next(keys), (3, 3, width, width))},
+                "norm2": _norm_init(width),
+            }
+            if in_ch != width:
+                block["proj"] = {"w": _conv_init(next(keys), (1, 1, in_ch, width))}
+            stage.append(block)
+            in_ch = width
+        params["stages"].append(stage)
+    params["head"] = {
+        "w": truncated_normal_init(next(keys), (WIDTHS[-1], num_classes), stddev=0.1),
+        "b": jnp.zeros((num_classes,), jnp.float32),
+    }
+    return params
+
+
+def _norm_init(ch: int) -> Params:
+    return {"scale": jnp.ones((ch,), jnp.float32),
+            "bias": jnp.zeros((ch,), jnp.float32)}
+
+
+def _group_norm(x: jax.Array, p: Params, groups: int = 8) -> jax.Array:
+    n, h, w, c = x.shape
+    g = min(groups, c)
+    xg = x.reshape(n, h, w, g, c // g).astype(jnp.float32)
+    mean = xg.mean(axis=(1, 2, 4), keepdims=True)
+    var = xg.var(axis=(1, 2, 4), keepdims=True)
+    xg = (xg - mean) * lax.rsqrt(var + 1e-5)
+    out = xg.reshape(n, h, w, c) * p["scale"] + p["bias"]
+    return out.astype(x.dtype)
+
+
+def _conv(x: jax.Array, w: jax.Array, stride: int = 1) -> jax.Array:
+    return lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def apply(params: Params, images: jax.Array, *, train: bool = False,
+          compute_dtype=jnp.bfloat16) -> jax.Array:
+    del train  # no dropout / batch stats
+    x = images.astype(compute_dtype)
+    p = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+
+    x = _conv(x, p["stem"]["w"])
+    x = jax.nn.relu(_group_norm(x, p["stem_norm"]))
+    for si, stage in enumerate(p["stages"]):
+        for bi, block in enumerate(stage):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            h = _conv(x, block["conv1"]["w"], stride)
+            h = jax.nn.relu(_group_norm(h, block["norm1"]))
+            h = _conv(h, block["conv2"]["w"])
+            h = _group_norm(h, block["norm2"])
+            if "proj" in block:
+                x = _conv(x, block["proj"]["w"], stride)
+            x = jax.nn.relu(x + h)
+    x = x.mean(axis=(1, 2))  # global average pool
+    logits = x @ p["head"]["w"] + p["head"]["b"]
+    return logits.astype(jnp.float32)

@@ -1,0 +1,103 @@
+"""A compact causal-LM transformer — the long-context model family.
+
+Not a reference-parity model (the reference has no attention anywhere,
+SURVEY §5.7); this exists so the framework's sequence-parallel path —
+ring attention over the mesh's ``seq`` axis (ops/ring_attention.py) —
+has a first-class consumer, and so the aggregation disciplines can be
+exercised on a transformer-shaped allreduce payload.
+
+Pure init/apply over a param pytree, pre-norm blocks, learned
+positional embeddings, weight-tied LM head. ``attention_fn`` is
+injectable: ``local_self_attention`` single-device, or a closure over
+``ring_self_attention(axis_name=...)`` under a seq-sharded shard_map.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from .cnn import truncated_normal_init
+from ..ops.ring_attention import local_self_attention
+
+Params = dict[str, Any]
+
+
+def init(key: jax.Array, vocab_size: int = 256, model_dim: int = 128,
+         num_heads: int = 4, num_layers: int = 2,
+         max_seq_len: int = 512) -> Params:
+    assert model_dim % num_heads == 0
+    keys = iter(jax.random.split(key, 4 + 4 * num_layers))
+    scale = 0.02
+    params: Params = {
+        "embed": truncated_normal_init(next(keys), (vocab_size, model_dim), scale),
+        "pos": truncated_normal_init(next(keys), (max_seq_len, model_dim), scale),
+        "blocks": [],
+        "final_norm": {"scale": jnp.ones((model_dim,), jnp.float32)},
+    }
+    for _ in range(num_layers):
+        params["blocks"].append({
+            "ln1": {"scale": jnp.ones((model_dim,), jnp.float32)},
+            "wqkv": truncated_normal_init(next(keys), (model_dim, 3 * model_dim), scale),
+            "wo": truncated_normal_init(next(keys), (model_dim, model_dim), scale),
+            "ln2": {"scale": jnp.ones((model_dim,), jnp.float32)},
+            "w1": truncated_normal_init(next(keys), (model_dim, 4 * model_dim), scale),
+            "w2": truncated_normal_init(next(keys), (4 * model_dim, model_dim), scale),
+        })
+    return params
+
+
+def _rms_norm(x: jax.Array, p: Params) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6) * p["scale"]).astype(x.dtype)
+
+
+def apply(params: Params, tokens: jax.Array, *, num_heads: int = 4,
+          attention_fn: Callable | None = None,
+          positions: jax.Array | None = None,
+          compute_dtype=jnp.bfloat16) -> jax.Array:
+    """tokens [batch, seq] int32 → logits [batch, seq, vocab] float32.
+
+    ``positions`` (global positions of this shard's tokens) must be
+    passed when the sequence is sharded; defaults to arange(seq).
+    """
+    attn = attention_fn or local_self_attention
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.arange(s)
+    p = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+    x = p["embed"][tokens] + p["pos"][positions]
+    d = x.shape[-1]
+    hd = d // num_heads
+    for blk in p["blocks"]:
+        h = _rms_norm(x, blk["ln1"])
+        qkv = h @ blk["wqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(b, -1, num_heads, hd).transpose(0, 2, 1, 3)
+
+        o = attn(heads(q), heads(k), heads(v))
+        o = o.transpose(0, 2, 1, 3).reshape(b, -1, d)
+        x = x + o @ blk["wo"]
+        h = _rms_norm(x, blk["ln2"])
+        x = x + jax.nn.relu(h @ blk["w1"]) @ blk["w2"]
+    x = _rms_norm(x, p["final_norm"])
+    logits = x @ p["embed"].T  # tied head
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Next-token mean xent. ``labels`` are the input tokens; targets
+    are labels shifted left (last position dropped)."""
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    tgt = labels[:, 1:].astype(jnp.int32)
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    pred = jnp.argmax(logits[:, :-1], axis=-1)
+    return jnp.mean((pred == labels[:, 1:]).astype(jnp.float32))

@@ -1,0 +1,3 @@
+from .timing import CdfStats, StepTimeCollector, compute_stats
+
+__all__ = ["CdfStats", "StepTimeCollector", "compute_stats"]

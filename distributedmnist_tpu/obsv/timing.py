@@ -1,0 +1,106 @@
+"""Step-time CDF collection and straggler statistics.
+
+≙ the reference's cluster-wide timing gossip: workers RPC-broadcast
+token-dequeue / gradients-done timestamps to worker 0, which aggregates
+and periodically logs ``ELAPSED TIMES`` / ``ITERATION TIMES`` tables
+(src/timeout_manager.py:31-70, src/distributed_train.py:305-307,
+344-345), later parsed into stdev/p80/p90/p95/p99/p100 stats and CDF
+plots (tools/benchmark.py:60-111,226-263).
+
+TPU-native collapse: per-replica step times come out of the train step
+as an all-gathered [n] vector (no RPC mesh, no shared-dict bug — the
+reference's ``[{}] * n`` aliasing, src/timeout_manager.py:31-32, is a
+documented quirk we do not copy). Collection is async-friendly: the
+collector holds device arrays and only materializes them at report
+points, so the device pipeline is never synced per step (SURVEY §7
+"hard parts": timing capture must not cost scaling efficiency).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+PERCENTILES = (50.0, 80.0, 90.0, 95.0, 99.0, 100.0)  # ≙ tools/benchmark.py:86-111
+
+
+@dataclasses.dataclass
+class CdfStats:
+    count: int
+    mean: float
+    stdev: float
+    percentiles: dict[str, float]
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"count": self.count, "mean": self.mean, "stdev": self.stdev,
+                **{f"p{p:g}": v for p, v in zip(PERCENTILES, self.percentiles.values())}}
+
+
+def compute_stats(samples: np.ndarray) -> CdfStats:
+    samples = np.asarray(samples, np.float64).ravel()
+    if samples.size == 0:
+        return CdfStats(0, float("nan"), float("nan"),
+                        {f"p{p:g}": float("nan") for p in PERCENTILES})
+    pcts = np.percentile(samples, PERCENTILES)
+    return CdfStats(
+        count=int(samples.size),
+        mean=float(samples.mean()),
+        stdev=float(samples.std()),
+        percentiles={f"p{p:g}": float(v) for p, v in zip(PERCENTILES, pcts)},
+    )
+
+
+class StepTimeCollector:
+    """Accumulates per-step, per-replica time vectors lazily.
+
+    ``add`` accepts a device array (or numpy) of shape [n_replicas] —
+    kept as-is; conversion happens at ``snapshot``/report time so adds
+    never force a device sync.
+    """
+
+    def __init__(self, num_replicas: int, capacity: int = 100_000):
+        self.num_replicas = num_replicas
+        self.capacity = capacity
+        self._raw: list[Any] = []
+        self._host_steps: list[float] = []  # host-measured wall per step
+
+    def add(self, per_replica_times: Any, host_step_seconds: float | None = None) -> None:
+        if len(self._raw) < self.capacity:
+            self._raw.append(per_replica_times)
+        if host_step_seconds is not None and len(self._host_steps) < self.capacity:
+            self._host_steps.append(host_step_seconds)
+
+    def matrix(self) -> np.ndarray:
+        """[steps, n_replicas] materialized compute times."""
+        if not self._raw:
+            return np.zeros((0, self.num_replicas))
+        return np.stack([np.asarray(t) for t in self._raw])
+
+    def per_replica_stats(self) -> list[CdfStats]:
+        """≙ per-worker ELAPSED TIMES stats (tools/benchmark.py:67-111)."""
+        m = self.matrix()
+        return [compute_stats(m[:, i]) for i in range(m.shape[1])] if m.size else []
+
+    def per_step_stats(self) -> CdfStats:
+        """Distribution over per-step *slowest replica* (the barrier
+        time in a full-sync step) — the p99 the north star tracks."""
+        m = self.matrix()
+        return compute_stats(m.max(axis=1) if m.size else np.empty(0))
+
+    def host_step_stats(self) -> CdfStats:
+        return compute_stats(np.asarray(self._host_steps))
+
+    def report(self) -> dict[str, Any]:
+        per_replica = self.per_replica_stats()
+        return {
+            "num_steps": len(self._raw),
+            "per_replica": [s.to_dict() for s in per_replica],
+            "barrier": self.per_step_stats().to_dict(),
+            "host_wall": self.host_step_stats().to_dict(),
+        }
+
+    def reset(self) -> None:
+        self._raw.clear()
+        self._host_steps.clear()

@@ -1,0 +1,8 @@
+from .drop_connect import drop_connect_grads
+from .masked_psum import masked_mean_psum
+from .ring_attention import local_self_attention, ring_self_attention
+
+__all__ = [
+    "drop_connect_grads", "masked_mean_psum",
+    "local_self_attention", "ring_self_attention",
+]

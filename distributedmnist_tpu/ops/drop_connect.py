@@ -1,0 +1,27 @@
+"""Drop-connect gradient masking.
+
+Capability parity with the reference's ``--drop_connect`` path: each
+gradient element is multiplied by an independent Bernoulli(p=0.9)
+sample before the update (src/distributed_train.py:60,98-99,194-196,
+202-203,414-416). As in the reference, there is NO 1/p rescaling —
+the expected gradient is deliberately attenuated.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def drop_connect_grads(grads: Any, key: jax.Array, keep_prob: float) -> Any:
+    """Apply an elementwise Bernoulli(keep_prob) mask to every gradient
+    leaf. Each leaf gets an independent fold of ``key``."""
+    leaves, treedef = jax.tree.flatten(grads)
+    keys = jax.random.split(key, len(leaves))
+    masked = [
+        g * jax.random.bernoulli(k, keep_prob, g.shape).astype(g.dtype)
+        for g, k in zip(leaves, keys)
+    ]
+    return jax.tree.unflatten(treedef, masked)

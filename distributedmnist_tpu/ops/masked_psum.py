@@ -1,0 +1,54 @@
+"""Masked-mean cross-replica reduction — the framework's core op.
+
+Replaces the reference's entire parameter-server aggregation stack:
+PS-hosted ``ConditionalAccumulator``s that average the first k
+gradients and drop stale ones
+(sync_replicas_optimizer_modified.py:287-306,363-378), per-worker token
+queues (:199-206), and the chief's sync loop (:389-410).
+
+TPU-native form: every replica contributes ``(grad · flag, flag)`` to a
+single ``lax.psum`` over the mesh's replica axis; the aggregated
+gradient is ``psum(grad·flag) / max(psum(flag), 1)``. Masked-out
+replicas (backups, stragglers past deadline, outside the interval
+window) contribute zeros — semantically identical to the PS dropping
+their gradients, but with no queues, no staleness window, and the
+reduction compiler-scheduled onto ICI all-reduce.
+
+Staleness (SURVEY §7 "hard parts") is structurally impossible here:
+SPMD replicas are in lockstep, so a masked-out step-t gradient simply
+never enters any accumulator that step t+1 could read.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def masked_mean_psum(tree: Any, flag: jax.Array, axis_name: str) -> tuple[Any, jax.Array]:
+    """Cross-replica masked mean of a pytree.
+
+    Args:
+      tree: per-replica pytree (e.g. gradients), inside shard_map.
+      flag: scalar 0/1 (or fractional weight) — this replica's
+        contribution mask.
+      axis_name: mesh axis to reduce over.
+
+    Returns:
+      (mean_tree, num_contributors): the masked mean — identical on all
+      replicas — and ``psum(flag)``. If no replica contributes, the mean
+      is all-zeros (the update becomes a no-op, mirroring a PS step with
+      an empty accumulator never firing).
+    """
+    flag = flag.astype(jnp.float32)
+    num = lax.psum(flag, axis_name)
+    denom = jnp.maximum(num, 1.0)
+    mean = jax.tree.map(
+        lambda g: lax.psum(g * flag.astype(g.dtype), axis_name) / denom.astype(g.dtype),
+        tree)
+    return mean, num
+
+

@@ -1,0 +1,5 @@
+from .api import TrainState, build_eval_step, build_train_step, init_train_state
+from . import policies
+
+__all__ = ["TrainState", "build_eval_step", "build_train_step",
+           "init_train_state", "policies"]

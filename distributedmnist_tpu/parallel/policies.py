@@ -1,0 +1,98 @@
+"""Contribution-mask policies — the reference's aggregation disciplines
+re-expressed for lockstep SPMD.
+
+Each policy answers one question per replica per step: *does this
+replica's gradient enter this step's masked-mean psum?* This single
+abstraction covers what the reference spreads across
+``SyncReplicasOptimizer`` quorum accumulation
+(src/distributed_train.py:184-188), the ``TimeoutReplicasOptimizer``'s
+two take-grad modes (sync_replicas_optimizer_modified.py:363-378), the
+disabled RPC straggler-kill (src/timeout_manager.py:38-46), and the
+chief's wall-clock interval timer
+(sync_replicas_optimizer_modified.py:208-215).
+
+Quorum semantics in lockstep SPMD (SURVEY §7 "hard parts"): "first k
+gradients win" is a race in the reference; replicas here arrive
+together. We reproduce the *statistical* behavior the reference's
+experiments sweep: each replica gets a per-step time — measured on real
+hardware and/or drawn from a synthetic straggler model (the reference
+induced stragglers by buying slow EC2 instance types,
+cfg/time_cdf_cfgs/*) — and the k fastest contribute. Selection is
+exactly k via lexicographic (time, replica_id) ranking.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import prng
+from ..core.config import SyncConfig
+
+
+def sample_step_time_ms(cfg: SyncConfig, root_key: jax.Array,
+                        step: jax.Array, replica: jax.Array,
+                        measured_ms: jax.Array) -> jax.Array:
+    """Model this replica's step time.
+
+    ``measured_ms`` is a host-injected base (real measured step time; 0
+    when unused). The synthetic straggler profile adds on top:
+
+    * "lognormal": heavy-tailed per-step compute time — matches the
+      shape of the per-worker CDFs the reference's Experiment C
+      measures (tools/benchmark.py:226-263).
+    * "spike": occasional large stalls (preemption-like).
+    * "none": a deterministic tiny per-replica jitter so that time
+      ranking still breaks ties uniquely.
+    """
+    key = prng.replica_key(root_key, "straggler", step, replica)
+    base = jnp.asarray(measured_ms, jnp.float32)
+    if cfg.straggler_profile == "lognormal":
+        z = jax.random.normal(key)
+        t = cfg.straggler_mean_ms * jnp.exp(cfg.straggler_sigma * z
+                                            - 0.5 * cfg.straggler_sigma**2)
+        return base + t
+    if cfg.straggler_profile == "spike":
+        spike = jax.random.bernoulli(key, cfg.straggler_spike_prob)
+        t = cfg.straggler_mean_ms * jnp.where(spike, cfg.straggler_spike_scale, 1.0)
+        return base + t
+    if cfg.straggler_profile == "none":
+        # sub-microsecond jitter: invisible in stats, unique for ranking
+        return base + jax.random.uniform(key, (), jnp.float32, 0.0, 1e-3)
+    raise ValueError(f"unknown straggler_profile {cfg.straggler_profile!r}")
+
+
+def rank_by_time(time_ms: jax.Array, axis_name: str) -> jax.Array:
+    """This replica's rank (0 = fastest) under lexicographic
+    (time, replica_id) order — deterministic and an exact permutation."""
+    n = lax.axis_size(axis_name)
+    times = lax.all_gather(time_ms, axis_name)  # [n]
+    ids = jnp.arange(n)
+    me = lax.axis_index(axis_name)
+    my_t = time_ms
+    earlier = (times < my_t) | ((times == my_t) & (ids < me))
+    return jnp.sum(earlier.astype(jnp.int32))
+
+
+def quorum_flag(time_ms: jax.Array, k: int, axis_name: str) -> jax.Array:
+    """k-of-n backup-worker mask: 1 for the k fastest replicas
+    (≙ replicas_to_aggregate=k; the n−k slowest are the "backups" whose
+    work is discarded, arXiv:1604.00981 semantics)."""
+    return (rank_by_time(time_ms, axis_name) < k).astype(jnp.float32)
+
+
+def timeout_flag(time_ms: jax.Array, timeout_ms: float) -> jax.Array:
+    """Deadline straggler drop: replicas slower than the deadline are
+    masked out instead of killed (≙ src/timeout_manager.py:38-46)."""
+    return (time_ms <= timeout_ms).astype(jnp.float32)
+
+
+def resolve_aggregate_k(cfg: SyncConfig, num_replicas: int) -> int:
+    """-1 → all replicas (reference default, src/distributed_train.py:118-121)."""
+    k = cfg.num_replicas_to_aggregate
+    if k == -1:
+        return num_replicas
+    if not (1 <= k <= num_replicas):
+        raise ValueError(f"num_replicas_to_aggregate={k} outside [1, {num_replicas}]")
+    return k

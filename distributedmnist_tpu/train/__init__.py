@@ -1,0 +1,7 @@
+from .checkpoint import (latest_checkpoint_step, restore_checkpoint,
+                         save_checkpoint)
+from .loop import Trainer
+from .lr_schedule import constant, decay_steps_for, exponential_decay
+
+__all__ = ["latest_checkpoint_step", "restore_checkpoint", "save_checkpoint",
+           "Trainer", "constant", "decay_steps_for", "exponential_decay"]

@@ -1,0 +1,110 @@
+"""Checkpoint save/restore.
+
+≙ the reference's ``tf.train.Saver`` + Supervisor autosave +
+restore-if-present (src/distributed_train.py:222,244-252,262,405-408)
+and the evaluator's read side (src/nn_eval.py:70-88). Differences:
+
+* msgpack-serialized pytrees (flax.serialization) written atomically
+  (tmp + rename) so a reader never sees a torn file — the reference
+  relies on Saver's own atomicity over NFS.
+* The data-iterator position and config are checkpointed too, so
+  *resume is exact* (the reference resumes params but restarts its
+  time-seeded data stream from scratch).
+* A ``checkpoint.json`` pointer names the latest step — the moral
+  equivalent of TF's ``checkpoint`` proto file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+from typing import Any
+
+import jax
+from flax import serialization
+
+from ..core.log import get_logger
+
+logger = get_logger("checkpoint")
+
+_POINTER = "checkpoint.json"
+
+
+def _ckpt_path(train_dir: Path, step: int) -> Path:
+    return train_dir / f"ckpt-{step:08d}.msgpack"
+
+
+def save_checkpoint(train_dir: str | Path, state: Any, step: int,
+                    extra: dict | None = None, keep: int = 5) -> Path:
+    """Atomically write state (+ JSON-serializable ``extra``) at ``step``."""
+    train_dir = Path(train_dir)
+    train_dir.mkdir(parents=True, exist_ok=True)
+    state = jax.device_get(state)
+    # extra goes through JSON (tuples etc. are not msgpack-clean)
+    payload = {"state": serialization.to_state_dict(state),
+               "extra": json.dumps(extra or {})}
+    data = serialization.msgpack_serialize(payload)
+    path = _ckpt_path(train_dir, step)
+    tmp = path.with_suffix(".tmp")
+    tmp.write_bytes(data)
+    os.replace(tmp, path)
+
+    pointer = {"latest_step": step, "latest_path": path.name,
+               "written_at": time.time()}
+    ptmp = train_dir / (_POINTER + ".tmp")
+    ptmp.write_text(json.dumps(pointer))
+    os.replace(ptmp, train_dir / _POINTER)
+
+    _garbage_collect(train_dir, keep)
+    logger.info("saved checkpoint step=%d → %s", step, path.name)
+    return path
+
+
+def _garbage_collect(train_dir: Path, keep: int) -> None:
+    if keep <= 0:
+        return
+    ckpts = sorted(train_dir.glob("ckpt-*.msgpack"))
+    for old in ckpts[:-keep]:
+        try:
+            old.unlink()
+        except OSError:
+            pass
+
+
+def latest_checkpoint_step(train_dir: str | Path) -> int | None:
+    """Read the pointer (≙ tf.train.get_checkpoint_state,
+    src/nn_eval.py:70); falls back to a directory scan if the pointer
+    is missing/torn."""
+    train_dir = Path(train_dir)
+    ptr = train_dir / _POINTER
+    if ptr.exists():
+        try:
+            d = json.loads(ptr.read_text())
+            if (train_dir / d["latest_path"]).exists():
+                return int(d["latest_step"])
+        except (json.JSONDecodeError, KeyError, ValueError):
+            pass
+    ckpts = sorted(train_dir.glob("ckpt-*.msgpack"))
+    if not ckpts:
+        return None
+    return int(ckpts[-1].stem.split("-")[1])
+
+
+def restore_checkpoint(train_dir: str | Path, template_state: Any,
+                       step: int | None = None) -> tuple[Any, dict, int] | None:
+    """Restore (state, extra, step); None when nothing exists
+    (≙ Supervisor's restore-if-present, src/distributed_train.py:262)."""
+    train_dir = Path(train_dir)
+    if step is None:
+        step = latest_checkpoint_step(train_dir)
+        if step is None:
+            return None
+    path = _ckpt_path(train_dir, step)
+    payload = serialization.msgpack_restore(path.read_bytes())
+    state = serialization.from_state_dict(template_state, payload["state"])
+    extra = payload.get("extra", {})
+    if isinstance(extra, (str, bytes)):
+        extra = json.loads(extra)
+    return state, extra, step

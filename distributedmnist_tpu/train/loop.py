@@ -1,0 +1,249 @@
+"""The training loop (≙ src/distributed_train.py:109-408, redesigned).
+
+What the reference's 300-line ``train()`` does with a Supervisor,
+queue-runner threads, a Twisted startup barrier, and per-step
+``sess.run``s, this does with: build step → jit once → feed sharded
+batches → log/checkpoint on cadence. There is no chief (every process
+is identical; process 0 merely owns file writes), no second forward
+pass per step (reference quirk at :332-335), and metric fetches are
+batched at log points so the device pipeline stays async between them.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import prng
+from ..core.config import ExperimentConfig
+from ..core.log import JsonlSink, get_logger, step_line
+from ..core.mesh import Topology, make_topology
+from ..data.datasets import Datasets, load_datasets
+from ..data.pipeline import eval_batches, make_train_iterator
+from ..models.registry import Model, get_model
+from ..obsv.timing import StepTimeCollector
+from ..parallel.api import (TrainState, build_eval_step, build_train_step,
+                            init_train_state)
+from . import checkpoint as ckpt
+from .lr_schedule import constant, decay_steps_for, exponential_decay
+
+logger = get_logger("train")
+
+
+class Trainer:
+    """Builds the whole training stack from one ExperimentConfig."""
+
+    def __init__(self, cfg: ExperimentConfig, topo: Topology | None = None,
+                 datasets: Datasets | None = None):
+        self.cfg = cfg
+        self.topo = topo or make_topology(cfg.mesh)
+        self.model: Model = get_model(cfg.model)
+        self.datasets = datasets if datasets is not None else load_datasets(
+            cfg.data, cfg.model.image_size, cfg.model.num_channels,
+            cfg.model.num_classes)
+
+        n = self.topo.num_replicas
+        if cfg.data.batch_size % n != 0:
+            raise ValueError(f"global batch {cfg.data.batch_size} not divisible "
+                             f"by {n} replicas")
+        from ..parallel.policies import resolve_aggregate_k
+        k = resolve_aggregate_k(cfg.sync, n)
+        # LR schedule keyed to applied updates; decay_steps ÷ k
+        # (src/distributed_train.py:143-156).
+        if cfg.optim.learning_rate_decay_factor == 1.0:
+            self.schedule = constant(cfg.optim.initial_learning_rate)
+        else:
+            steps = decay_steps_for(self.datasets.train.num_examples,
+                                    cfg.data.batch_size,
+                                    cfg.optim.num_epochs_per_decay, k)
+            self.schedule = exponential_decay(
+                cfg.optim.initial_learning_rate, steps,
+                cfg.optim.learning_rate_decay_factor, cfg.optim.staircase)
+
+        self.step_fn = build_train_step(self.model, cfg, self.topo, self.schedule)
+        self.eval_fn = build_eval_step(self.model, cfg, self.topo)
+        self.state: TrainState = init_train_state(self.model, cfg)
+        self.state = self.topo.device_put_replicated(self.state)
+
+        self.train_iter = make_train_iterator(
+            self.datasets.train, cfg.data, seed=cfg.train.seed,
+            host_id=jax.process_index(), num_hosts=jax.process_count())
+
+        self.collector = StepTimeCollector(num_replicas=n)
+        self.is_writer = jax.process_index() == 0
+        self.train_dir = Path(cfg.train.train_dir)
+        self._sink: JsonlSink | None = None
+        self._series: list[tuple[float, int, float, float]] = []  # (t, step, loss, acc)
+        self._last_save_time = time.time()
+        self._start_step = 0
+
+        if cfg.train.resume:
+            self._maybe_resume()
+
+    # ------------------------------------------------------------------
+
+    def _maybe_resume(self) -> None:
+        restored = ckpt.restore_checkpoint(self.train_dir, self.state)
+        if restored is None:
+            return
+        state, extra, step = restored
+        self.state = self.topo.device_put_replicated(state)
+        if "data_iter" in extra:
+            try:
+                self.train_iter.restore(extra["data_iter"])
+            except (AttributeError, KeyError, ValueError):
+                logger.warning("could not restore data-iterator state; "
+                               "restarting stream")
+        self._start_step = int(jax.device_get(self.state.step))
+        logger.info("resumed from checkpoint step=%d (loop step %d)",
+                    step, self._start_step)
+
+    def _save(self, step: int) -> None:
+        if not self.is_writer:
+            return
+        extra = {"config": self.cfg.to_dict()}
+        iter_state = getattr(self.train_iter, "state", None)
+        if callable(iter_state):
+            extra["data_iter"] = self.train_iter.state()
+        ckpt.save_checkpoint(self.train_dir, self.state,
+                             int(jax.device_get(self.state.step)),
+                             extra=extra, keep=self.cfg.train.keep_checkpoints)
+        self._last_save_time = time.time()
+
+    def _sink_write(self, record: dict) -> None:
+        if self.is_writer:
+            if self._sink is None:
+                self._sink = JsonlSink(self.train_dir / "train_log.jsonl")
+            self._sink.write(record)
+
+    def _dump_series(self) -> None:
+        """≙ worker%d_time_acc.npy dumps (src/distributed_train.py:373-379)."""
+        if self.is_writer and self._series:
+            np.save(self.train_dir / "time_acc.npy", np.asarray(self._series))
+
+    # ------------------------------------------------------------------
+
+    def evaluate(self, split: str = "test") -> dict[str, float]:
+        """One full-split eval pass (in-loop convenience; the
+        continuous evaluator lives in ``evalsvc``)."""
+        data = getattr(self.datasets, split)
+        n = self.topo.num_replicas
+        hosts = jax.process_count()
+        bs = max(n, min(4096, data.num_examples))
+        correct = loss_sum = weight = 0.0
+        params = self.state.params
+        for batch in eval_batches(data, bs, pad_multiple=max(1, n // hosts),
+                                  host_id=jax.process_index(), num_hosts=hosts):
+            c, l, w = self.eval_fn(params, self.topo.device_put_batch(batch))
+            correct += float(c)
+            loss_sum += float(l)
+            weight += float(w)
+        return {"accuracy": correct / max(weight, 1.0),
+                "loss": loss_sum / max(weight, 1.0),
+                "num_examples": int(weight)}
+
+    def run(self, max_steps: int | None = None,
+            step_callback: Callable[[int, dict], None] | None = None) -> dict[str, Any]:
+        """Run the loop; returns a summary dict."""
+        cfg = self.cfg.train
+        total = max_steps if max_steps is not None else cfg.max_steps
+        profile_start, profile_stop = cfg.profile_steps
+        profiling = False
+        log_every = max(1, cfg.log_every_steps)
+        last_log_t = time.time()
+        last_log_step = self._start_step
+        pending: list[tuple[int, dict, float]] = []
+        final_metrics: dict[str, float] = {}
+        # With no synthetic straggler model, per-replica step times are
+        # driven by the real measured host step time (this is what paces
+        # interval windows / timeout deadlines on real hardware).
+        inject_measured = (self.cfg.sync.straggler_profile == "none"
+                           and self.cfg.sync.mode in ("interval", "timeout",
+                                                      "quorum", "cdf"))
+        host_dt = 0.0
+
+        def flush(now: float) -> None:
+            nonlocal final_metrics, last_log_t, last_log_step
+            if not pending:
+                return
+            upto = pending[-1][0]
+            rate = ((upto - last_log_step) * self.cfg.data.batch_size
+                    / max(now - last_log_t, 1e-9))
+            for s, m, t in pending:
+                loss = float(m["loss"])
+                acc = float(m["train_acc"])
+                self._series.append((t, s, loss, acc))
+                record = {
+                    "event": "step", "step": s, "loss": loss,
+                    "train_acc": acc, "lr": float(m["lr"]),
+                    "updates_applied": int(m["updates_applied"]),
+                    "num_contributors": float(m["num_contributors"]),
+                    "examples_per_sec": rate,
+                }
+                self._sink_write(record)
+                final_metrics = record
+                if step_callback:
+                    step_callback(s, record)
+            # canonical line for the last flushed step
+            logger.info(step_line(jax.process_index(), upto,
+                                  final_metrics["loss"],
+                                  final_metrics["train_acc"], rate,
+                                  (now - last_log_t) / max(upto - last_log_step, 1)))
+            pending.clear()
+            last_log_t, last_log_step = now, upto
+
+        self.train_dir.mkdir(parents=True, exist_ok=True)
+        step = self._start_step
+        while step < total:
+            in_window = profile_stop > profile_start and profile_start <= step < profile_stop
+            if in_window and not profiling and self.is_writer:
+                jax.profiler.start_trace(str(self.train_dir / "profile"))
+                profiling = True
+            t0 = time.time()
+            batch = next(self.train_iter)
+            gbatch = self.topo.device_put_batch(batch)
+            if inject_measured:
+                self.state = self.state.replace(
+                    measured_ms=jnp.float32(host_dt * 1000.0))
+            self.state, metrics = self.step_fn(self.state, gbatch)
+            host_dt = time.time() - t0
+            step += 1
+            self.collector.add(metrics["step_times_ms"], host_dt)
+            pending.append((step, metrics, time.time()))
+
+            if step % log_every == 0:
+                flush(time.time())
+
+            if profiling and step >= profile_stop:
+                jax.profiler.stop_trace()
+                profiling = False
+
+            if cfg.save_interval_secs > 0:
+                if time.time() - self._last_save_time >= cfg.save_interval_secs:
+                    self._save(step)
+            elif cfg.save_interval_steps > 0 and step % cfg.save_interval_steps == 0:
+                self._save(step)
+            if cfg.save_results_period > 0 and step % cfg.save_results_period == 0:
+                self._dump_series()
+
+        flush(time.time())  # records past the last log boundary
+        if profiling:
+            jax.profiler.stop_trace()
+        # final save (≙ chief final saver.save, src/distributed_train.py:405-408)
+        self._save(step)
+        self._dump_series()
+        if self._sink:
+            self._sink.close()
+            self._sink = None
+        summary = {
+            "final_step": step,
+            "updates_applied": int(jax.device_get(self.state.updates_applied)),
+            "last_metrics": final_metrics,
+            "timing": self.collector.report(),
+        }
+        return summary

@@ -1,0 +1,50 @@
+"""Learning-rate schedule.
+
+Exponential staircase decay with the reference's exact semantics
+(src/distributed_train.py:143-156): decay is keyed to the number of
+*applied updates* (the reference's global_step — which counts PS
+applies, not worker iterations), and
+
+    decay_steps = (num_examples / batch_size) * num_epochs_per_decay / k
+
+where ``k = num_replicas_to_aggregate`` (src/distributed_train.py:147)
+— so convergence curves stay comparable across quorum settings.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+Schedule = Callable[[jax.Array], jax.Array]
+
+
+def decay_steps_for(num_examples: int, batch_size: int,
+                    num_epochs_per_decay: float, aggregate_k: int) -> int:
+    num_batches_per_epoch = num_examples / batch_size
+    return max(1, int(num_batches_per_epoch * num_epochs_per_decay / aggregate_k))
+
+
+def exponential_decay(initial_lr: float, decay_steps: int,
+                      decay_factor: float, staircase: bool = True) -> Schedule:
+    """≙ tf.train.exponential_decay(staircase=True) at
+    src/distributed_train.py:152-156."""
+
+    def schedule(updates_applied: jax.Array) -> jax.Array:
+        p = jnp.asarray(updates_applied, jnp.float32) / float(decay_steps)
+        if staircase:
+            p = jnp.floor(p)
+        return jnp.asarray(initial_lr, jnp.float32) * jnp.power(decay_factor, p)
+
+    return schedule
+
+
+def constant(lr: float) -> Schedule:
+    """No decay — the reference's 50-worker sweeps set decay_factor=1.0
+    (cfg/50_workers/*_aggregate_sync:63-65)."""
+    def schedule(updates_applied: jax.Array) -> jax.Array:
+        del updates_applied
+        return jnp.asarray(lr, jnp.float32)
+    return schedule

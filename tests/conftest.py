@@ -1,0 +1,52 @@
+"""Test harness: 8 virtual CPU devices for SPMD semantics.
+
+This is the mock distributed backend the reference never had
+(SURVEY §4): quorum masks, psum semantics, interval windows, and
+checkpoint round-trips are all exercised against a simulated 8-device
+mesh on one CPU host. Platform setup MUST happen before any test
+import initializes the XLA backend.
+"""
+
+from distributedmnist_tpu.core.mesh import simulate_devices
+
+simulate_devices(8)
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def topo8():
+    from distributedmnist_tpu.core.mesh import make_topology
+    assert len(jax.devices()) == 8, "conftest failed to create 8 CPU devices"
+    return make_topology()
+
+
+@pytest.fixture()
+def tmp_train_dir(tmp_path):
+    return str(tmp_path / "train")
+
+
+@pytest.fixture(scope="session")
+def synthetic_datasets():
+    from distributedmnist_tpu.data.datasets import make_synthetic
+    return make_synthetic(num_train=2048, num_test=512)
+
+
+def base_config(**overrides):
+    """Small fast config for tests; sections overridable via dicts."""
+    from distributedmnist_tpu.core.config import ExperimentConfig
+    d = {
+        "data": {"dataset": "synthetic", "batch_size": 64,
+                 "synthetic_train_size": 1024, "synthetic_test_size": 256,
+                 "use_native_pipeline": False},
+        "model": {"compute_dtype": "float32"},
+        "train": {"max_steps": 10, "log_every_steps": 5,
+                  "save_interval_steps": 0, "save_results_period": 0},
+    }
+    for k, v in overrides.items():
+        if isinstance(v, dict) and k in d:
+            d[k].update(v)
+        else:
+            d[k] = v
+    return ExperimentConfig.from_dict(d)

@@ -1,0 +1,74 @@
+"""Checkpoint round-trip + resume tests (≙ SURVEY §5.4)."""
+
+import numpy as np
+import jax
+
+from conftest import base_config
+from distributedmnist_tpu.train import checkpoint as ckpt
+
+
+def _state_and_model(mode="sync"):
+    from distributedmnist_tpu.core.config import ExperimentConfig
+    from distributedmnist_tpu.models.registry import get_model
+    from distributedmnist_tpu.parallel.api import init_train_state
+    cfg = base_config(sync={"mode": mode})
+    model = get_model(cfg.model)
+    return init_train_state(model, cfg), model, cfg
+
+
+def test_roundtrip_identity(tmp_path):
+    state, model, _ = _state_and_model()
+    ckpt.save_checkpoint(tmp_path, state, 7, extra={"note": "hi"})
+    template, _, _ = _state_and_model()
+    restored, extra, step = ckpt.restore_checkpoint(tmp_path, template)
+    assert step == 7
+    assert extra == {"note": "hi"}
+    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_roundtrip_interval_state(tmp_path):
+    """Interval mode carries a window accumulator — must survive."""
+    state, _, _ = _state_and_model(mode="interval")
+    assert state.window_acc is not None
+    ckpt.save_checkpoint(tmp_path, state, 3)
+    template, _, _ = _state_and_model(mode="interval")
+    restored, _, step = ckpt.restore_checkpoint(tmp_path, template)
+    assert step == 3
+    assert restored.window_acc is not None
+
+
+def test_latest_pointer_and_gc(tmp_path):
+    state, _, _ = _state_and_model()
+    for s in (1, 2, 3, 4, 5, 6, 7):
+        ckpt.save_checkpoint(tmp_path, state, s, keep=3)
+    assert ckpt.latest_checkpoint_step(tmp_path) == 7
+    kept = sorted(p.name for p in tmp_path.glob("ckpt-*.msgpack"))
+    assert len(kept) == 3
+    assert kept[-1] == "ckpt-00000007.msgpack"
+
+
+def test_missing_dir_returns_none(tmp_path):
+    state, _, _ = _state_and_model()
+    assert ckpt.restore_checkpoint(tmp_path / "nope", state) is None
+
+
+def test_torn_pointer_falls_back_to_scan(tmp_path):
+    state, _, _ = _state_and_model()
+    ckpt.save_checkpoint(tmp_path, state, 5)
+    (tmp_path / "checkpoint.json").write_text("{not json")
+    assert ckpt.latest_checkpoint_step(tmp_path) == 5
+
+
+def test_trainer_resume_continues(tmp_train_dir, synthetic_datasets):
+    from distributedmnist_tpu.train.loop import Trainer
+    cfg = base_config(train={"train_dir": tmp_train_dir, "max_steps": 10,
+                             "log_every_steps": 5, "save_interval_steps": 5})
+    t1 = Trainer(cfg, datasets=synthetic_datasets)
+    t1.run()
+    t2 = Trainer(cfg, datasets=synthetic_datasets)
+    assert t2._start_step == 10
+    s = t2.run(max_steps=14)
+    assert s["final_step"] == 14
+    # data iterator resumed, not restarted
+    assert t2.train_iter.state()["pos"] > 0 or t2.train_iter.state()["epoch"] > 0

@@ -1,0 +1,68 @@
+"""Config system tests (≙ SURVEY §5.6 — safe literals replace eval'd Cfg)."""
+
+import json
+
+import pytest
+
+from distributedmnist_tpu.core.config import (ConfigError, ExperimentConfig,
+                                              parse_cli_overrides)
+
+
+def test_defaults_roundtrip():
+    cfg = ExperimentConfig()
+    d = cfg.to_dict()
+    assert ExperimentConfig.from_dict(d) == cfg
+
+
+def test_from_file_json(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"name": "exp1", "sync": {"mode": "quorum",
+                                                     "num_replicas_to_aggregate": 4}}))
+    cfg = ExperimentConfig.from_file(p)
+    assert cfg.name == "exp1"
+    assert cfg.sync.mode == "quorum"
+    assert cfg.sync.num_replicas_to_aggregate == 4
+
+
+def test_from_file_python_literal(tmp_path):
+    p = tmp_path / "cfg.py"
+    p.write_text("{'name': 'lit', 'data': {'batch_size': 512}}")
+    cfg = ExperimentConfig.from_file(p)
+    assert cfg.data.batch_size == 512
+
+
+def test_from_file_rejects_code(tmp_path):
+    p = tmp_path / "cfg.py"
+    p.write_text("__import__('os').system('true') or {}")
+    with pytest.raises(ConfigError):
+        ExperimentConfig.from_file(p)
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(ConfigError):
+        ExperimentConfig.from_dict({"sync": {"no_such_knob": 1}})
+
+
+def test_dotted_overrides():
+    cfg = ExperimentConfig().override({"sync.mode": "timeout",
+                                       "train.max_steps": 42,
+                                       "optim.initial_learning_rate": 8e-4})
+    assert cfg.sync.mode == "timeout"
+    assert cfg.train.max_steps == 42
+    assert cfg.optim.initial_learning_rate == 8e-4
+
+
+def test_cli_override_parsing():
+    out = parse_cli_overrides(["sync.mode=quorum", "train.max_steps=7",
+                               "data.shard_mode=independent"])
+    assert out == {"sync.mode": "quorum", "train.max_steps": 7,
+                   "data.shard_mode": "independent"}
+    with pytest.raises(ConfigError):
+        parse_cli_overrides(["nonsense"])
+
+
+def test_save_load(tmp_path):
+    cfg = ExperimentConfig().override({"name": "saved"})
+    p = tmp_path / "out.json"
+    cfg.save(p)
+    assert ExperimentConfig.from_file(p) == cfg

@@ -1,0 +1,63 @@
+"""Continuous-evaluator tests (≙ src/nn_eval.py behavior contract)."""
+
+import re
+
+from conftest import base_config
+
+
+def _train(tmp_train_dir, synthetic_datasets, steps=30):
+    from distributedmnist_tpu.train.loop import Trainer
+    cfg = base_config(train={"train_dir": tmp_train_dir, "max_steps": steps,
+                             "log_every_steps": 10, "save_interval_steps": 10})
+    t = Trainer(cfg, datasets=synthetic_datasets)
+    t.run()
+    return cfg
+
+
+def test_evaluator_reads_checkpoints(tmp_train_dir, synthetic_datasets,
+                                     tmp_path, capsys):
+    from distributedmnist_tpu.core.config import EvalConfig
+    from distributedmnist_tpu.evalsvc import Evaluator
+    cfg = _train(tmp_train_dir, synthetic_datasets, steps=120)
+    ecfg = EvalConfig(eval_dir=str(tmp_path / "eval"), run_once=True,
+                      eval_interval_secs=0.01)
+    ev = Evaluator(tmp_train_dir, ecfg, cfg=cfg, datasets=synthetic_datasets)
+    results = ev.run()
+    assert len(results) == 1
+    r = results[0]
+    assert r["step"] == 120
+    assert r["num_examples"] == synthetic_datasets.test.num_examples
+    assert r["precision_at_1"] >= 0.99
+    # the reference-parity parseable line (src/nn_eval.py:102-103)
+    out = capsys.readouterr().out
+    m = re.search(r"Num examples: (\d+) Precision @ 1: ([0-9.]+) "
+                  r"Loss: ([0-9.]+) Time: ([0-9.]+)", out)
+    assert m, out
+    assert int(m.group(1)) == r["num_examples"]
+
+
+def test_evaluator_skips_unchanged_step(tmp_train_dir, synthetic_datasets, tmp_path):
+    """≙ the global-step-unchanged skip (src/nn_eval.py:84-88)."""
+    from distributedmnist_tpu.core.config import EvalConfig
+    from distributedmnist_tpu.evalsvc import Evaluator
+    cfg = _train(tmp_train_dir, synthetic_datasets)
+    ecfg = EvalConfig(eval_dir=str(tmp_path / "eval"), max_evals=1,
+                      eval_interval_secs=0.01)
+    ev = Evaluator(tmp_train_dir, ecfg, cfg=cfg, datasets=synthetic_datasets)
+    ev.run()
+    assert ev.last_step_evaluated == 30
+    # second poll with no new checkpoint: evaluate_checkpoint not re-run
+    from distributedmnist_tpu.train import checkpoint as ckpt
+    assert ckpt.latest_checkpoint_step(tmp_train_dir) == ev.last_step_evaluated
+
+
+def test_evaluator_adopts_checkpoint_config(tmp_train_dir, synthetic_datasets, tmp_path):
+    """The evaluator rebuilds the exact trainer config from the
+    checkpoint itself — no trainer/evaluator graph skew."""
+    from distributedmnist_tpu.core.config import EvalConfig
+    from distributedmnist_tpu.evalsvc import Evaluator
+    cfg = _train(tmp_train_dir, synthetic_datasets)
+    ev = Evaluator(tmp_train_dir, EvalConfig(eval_dir=str(tmp_path / "e")),
+                   datasets=synthetic_datasets)
+    assert ev.cfg.data.batch_size == cfg.data.batch_size
+    assert ev.cfg.model == cfg.model

@@ -1,0 +1,77 @@
+"""Sweep runner + CLI tests (≙ tools/benchmark.py / tools/tf_ec2.py roles)."""
+
+import json
+
+import pytest
+
+from conftest import base_config
+
+
+def test_run_experiment_produces_record(tmp_path, synthetic_datasets):
+    from distributedmnist_tpu.launch.sweep import run_experiment
+    cfg = base_config(name="exp_sync",
+                      sync={"mode": "quorum", "num_replicas_to_aggregate": 4,
+                            "straggler_profile": "lognormal"},
+                      train={"max_steps": 15, "log_every_steps": 5})
+    rec = run_experiment(cfg, tmp_path, datasets=synthetic_datasets)
+    assert rec["name"] == "exp_sync"
+    assert rec["steps"] == 15
+    assert 0.0 <= rec["test_accuracy"] <= 1.0
+    assert (tmp_path / "exp_sync" / "result.json").exists()
+    assert (tmp_path / "exp_sync" / "config.json").exists()
+
+
+def test_run_sweep_report(tmp_path, synthetic_datasets):
+    from distributedmnist_tpu.launch.sweep import run_sweep
+    cfgs = [base_config(name=f"s{k}",
+                        sync={"mode": "quorum", "num_replicas_to_aggregate": k,
+                              "straggler_profile": "lognormal"},
+                        train={"max_steps": 8, "log_every_steps": 4})
+            for k in (2, 8)]
+    records = run_sweep(cfgs, tmp_path, datasets=synthetic_datasets)
+    assert len(records) == 2
+    report = (tmp_path / "report.md").read_text()
+    assert "s2" in report and "s8" in report
+    lines = (tmp_path / "sweep_results.jsonl").read_text().strip().split("\n")
+    assert len(lines) == 2
+    assert (tmp_path / "step_time_cdf.png").exists()
+
+
+def test_load_sweep_configs_rejects_duplicates(tmp_path):
+    from distributedmnist_tpu.launch.sweep import load_sweep_configs
+    (tmp_path / "a.json").write_text(json.dumps({"name": "dup"}))
+    (tmp_path / "b.json").write_text(json.dumps({"name": "dup"}))
+    with pytest.raises(ValueError):
+        load_sweep_configs(tmp_path)
+
+
+def test_repo_sweep_configs_all_parse():
+    """Every shipped config in configs/ must load cleanly."""
+    from pathlib import Path
+    from distributedmnist_tpu.launch.sweep import load_sweep_configs
+    root = Path(__file__).resolve().parent.parent / "configs"
+    cfgs = load_sweep_configs(root)
+    assert len(cfgs) >= 15
+    modes = {c.sync.mode for c in cfgs}
+    assert {"quorum", "interval", "cdf", "sync", "timeout"} <= modes
+
+
+def test_cli_devices(capsys):
+    from distributedmnist_tpu.launch.__main__ import main
+    main(["devices"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["process_count"] == 1
+    assert len(out["devices"]) == 8
+
+
+def test_cli_train_with_overrides(tmp_path, capsys):
+    from distributedmnist_tpu.launch.__main__ import main
+    main(["train",
+          "data.dataset=synthetic", "data.batch_size=64",
+          "data.synthetic_train_size=512", "data.synthetic_test_size=128",
+          "model.compute_dtype=float32",
+          "train.max_steps=6", "train.log_every_steps=3",
+          f"train.train_dir={tmp_path}/t", "train.save_interval_steps=0"])
+    out = json.loads(capsys.readouterr().out.strip().split("\n")[-1])
+    assert out["summary"]["final_step"] == 6
+    assert "accuracy" in out["test"]

@@ -1,0 +1,89 @@
+"""Mask-math unit tests on the simulated 8-device mesh (SURVEY §4
+"implication": test psum semantics without a TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from distributedmnist_tpu.ops.masked_psum import masked_mean_psum
+
+
+def run_sharded(topo, fn, *args, in_specs, out_specs):
+    return jax.jit(jax.shard_map(fn, mesh=topo.mesh, in_specs=in_specs,
+                                 out_specs=out_specs))(*args)
+
+
+def test_all_ones_is_plain_mean(topo8):
+    x = jnp.arange(8.0)
+
+    def f(x):
+        mean, num = masked_mean_psum(x, jnp.ones(()), "replica")
+        return mean, num
+
+    mean, num = run_sharded(topo8, f, x, in_specs=(P("replica"),),
+                            out_specs=(P(), P()))
+    assert float(num) == 8.0
+    np.testing.assert_allclose(np.asarray(mean), np.mean(np.arange(8.0)))
+
+
+def test_mask_drops_contributions(topo8):
+    x = jnp.arange(8.0)
+    flags = jnp.array([1, 1, 0, 0, 1, 0, 0, 0], jnp.float32)
+
+    def f(x, fl):
+        mean, num = masked_mean_psum(x, fl[0], "replica")
+        return mean, num
+
+    mean, num = run_sharded(topo8, f, x, flags, in_specs=(P("replica"), P("replica")),
+                            out_specs=(P(), P()))
+    assert float(num) == 3.0
+    np.testing.assert_allclose(np.asarray(mean), (0 + 1 + 4) / 3.0)
+
+
+def test_all_masked_gives_zero(topo8):
+    x = jnp.arange(8.0) + 5.0
+    flags = jnp.zeros(8, jnp.float32)
+
+    def f(x, fl):
+        return masked_mean_psum(x, fl[0], "replica")
+
+    mean, num = run_sharded(topo8, f, x, flags, in_specs=(P("replica"), P("replica")),
+                            out_specs=(P(), P()))
+    assert float(num) == 0.0
+    np.testing.assert_allclose(np.asarray(mean), 0.0)
+
+
+def test_masked_mean_of_pytree(topo8):
+    tree = {"a": jnp.arange(8.0), "b": jnp.arange(16.0).reshape(8, 2)}
+    flags = jnp.array([1, 0, 1, 0, 1, 0, 1, 0], jnp.float32)
+
+    def f(t, fl):
+        mean, num = masked_mean_psum(t, fl[0], "replica")
+        return mean, num
+
+    mean, num = run_sharded(
+        topo8, f, tree, flags,
+        in_specs=({"a": P("replica"), "b": P("replica")}, P("replica")),
+        out_specs=(P(), P()))
+    assert float(num) == 4.0
+    np.testing.assert_allclose(np.asarray(mean["a"]), np.mean([0, 2, 4, 6]))
+    np.testing.assert_allclose(np.asarray(mean["b"]).ravel(),
+                               np.arange(16).reshape(8, 2)[::2].mean(axis=0))
+
+
+def test_fractional_flags_weight_contributions(topo8):
+    """Flags need not be binary — fractional weights scale contributions."""
+    x = jnp.arange(8.0)
+    w = jnp.array([1, 2, 3, 0, 0, 0, 0, 0], jnp.float32)
+
+    def f(x, w):
+        mean, num = masked_mean_psum(x, w[0], "replica")
+        return mean, num
+
+    mean, num = run_sharded(topo8, f, x, w, in_specs=(P("replica"), P("replica")),
+                            out_specs=(P(), P()))
+    assert float(num) == 6.0
+    np.testing.assert_allclose(np.asarray(mean), (0 * 1 + 1 * 2 + 2 * 3) / 6.0)

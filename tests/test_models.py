@@ -1,0 +1,113 @@
+"""Model-family numerics tests (≙ SURVEY §2.1 "Model" row parity)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedmnist_tpu.core.config import ModelConfig
+from distributedmnist_tpu.models import available, get_model
+from distributedmnist_tpu.models import cnn
+
+
+def test_registry_lists_families():
+    assert {"mnist_cnn", "resnet20", "transformer"} <= set(available())
+
+
+def test_all_registered_models_buildable():
+    """Every advertised family must init+apply (regression: registry
+    used to list families whose modules didn't exist)."""
+    for name in available():
+        cfg = ModelConfig(name=name, compute_dtype="float32",
+                          num_channels=3 if name == "resnet20" else 1,
+                          image_size=32 if name == "resnet20" else 28,
+                          seq_len=32, model_dim=32, num_heads=2, num_layers=1)
+        model = get_model(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        x = jnp.zeros((2,) + model.input_shape, model.input_dtype)
+        logits = model.apply(params, x, train=False)
+        assert logits.shape[0] == 2
+        assert jnp.all(jnp.isfinite(logits))
+
+
+def test_cnn_param_shapes_and_init_constants():
+    """Parity with reference init (src/mnist.py:81-101): conv1 bias 0,
+    conv2/fc biases 0.1, truncated-normal weights with stddev 0.1."""
+    params = cnn.init(jax.random.PRNGKey(66478))
+    assert params["conv1"]["w"].shape == (5, 5, 1, 32)
+    assert params["conv2"]["w"].shape == (5, 5, 32, 64)
+    assert params["fc1"]["w"].shape == (7 * 7 * 64, 512)
+    assert params["fc2"]["w"].shape == (512, 10)
+    np.testing.assert_array_equal(np.asarray(params["conv1"]["b"]), 0.0)
+    np.testing.assert_allclose(np.asarray(params["conv2"]["b"]), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(params["fc1"]["b"]), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(params["fc2"]["b"]), 0.1, rtol=1e-6)
+    # truncated at ±2σ = ±0.2
+    w = np.asarray(params["fc1"]["w"])
+    assert np.abs(w).max() <= 0.2 + 1e-6
+    assert 0.05 < w.std() < 0.15
+
+
+def test_cnn_loss_matches_manual_xent():
+    logits = jnp.array([[2.0, 1.0, 0.1], [0.5, 2.5, 0.2]])
+    labels = jnp.array([0, 1])
+    got = float(cnn.loss_fn(logits, labels))
+    p = jax.nn.log_softmax(logits)
+    want = float(-(p[0, 0] + p[1, 1]) / 2)
+    assert got == pytest.approx(want, rel=1e-6)
+
+
+def test_cnn_accuracy():
+    logits = jnp.array([[2.0, 1.0], [0.1, 3.0], [5.0, 0.0], [0.0, 1.0]])
+    labels = jnp.array([0, 1, 1, 1])
+    assert float(cnn.accuracy(logits, labels)) == pytest.approx(0.75)
+
+
+def test_cnn_dropout_train_vs_eval():
+    params = cnn.init(jax.random.PRNGKey(1))
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 28, 28, 1))
+    eval_logits = cnn.apply(params, x, train=False, compute_dtype=jnp.float32)
+    k = jax.random.PRNGKey(3)
+    train_logits = cnn.apply(params, x, train=True, dropout_key=k,
+                             compute_dtype=jnp.float32)
+    assert not np.allclose(np.asarray(eval_logits), np.asarray(train_logits))
+    # dropout requires a key
+    with pytest.raises(ValueError):
+        cnn.apply(params, x, train=True, compute_dtype=jnp.float32)
+    # deterministic given the key
+    again = cnn.apply(params, x, train=True, dropout_key=k,
+                      compute_dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(train_logits), np.asarray(again))
+
+
+def test_resnet20_learns_a_step():
+    from distributedmnist_tpu.models import resnet
+    params = resnet.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3)) * 0.3
+    y = jnp.array([0, 1, 2, 3])
+
+    def loss(p):
+        return cnn.loss_fn(resnet.apply(p, x, compute_dtype=jnp.float32), y)
+
+    l0 = float(loss(params))
+    g = jax.grad(loss)(params)
+    params2 = jax.tree.map(lambda p_, g_: p_ - 0.1 * g_, params, g)
+    assert float(loss(params2)) < l0
+
+
+def test_transformer_next_token_loss_decreases():
+    from distributedmnist_tpu.models import transformer
+    params = transformer.init(jax.random.PRNGKey(0), vocab_size=17,
+                              model_dim=32, num_heads=2, num_layers=1,
+                              max_seq_len=16)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 17)
+
+    def loss(p):
+        logits = transformer.apply(p, toks, num_heads=2,
+                                   compute_dtype=jnp.float32)
+        return transformer.loss_fn(logits, toks)
+
+    l0 = float(loss(params))
+    g = jax.grad(loss)(params)
+    params2 = jax.tree.map(lambda p_, g_: p_ - 0.5 * g_, params, g)
+    assert float(loss(params2)) < l0

@@ -1,0 +1,92 @@
+"""Contribution-mask policy tests (≙ the reference's three aggregation
+disciplines, SURVEY §2.2, as pure mask math)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from distributedmnist_tpu.core import prng
+from distributedmnist_tpu.core.config import SyncConfig
+from distributedmnist_tpu.parallel import policies
+
+
+def _flags_for_times(topo8, times, k):
+    def fn(t):
+        return policies.quorum_flag(t[0], k, "replica")[None]
+
+    return np.asarray(jax.jit(jax.shard_map(
+        fn, mesh=topo8.mesh, in_specs=(P("replica"),),
+        out_specs=P("replica")))(jnp.asarray(times, jnp.float32)))
+
+
+def test_quorum_selects_exactly_k_fastest(topo8):
+    times = [5.0, 1.0, 9.0, 3.0, 7.0, 2.0, 8.0, 4.0]
+    flags = _flags_for_times(topo8, times, k=3)
+    assert flags.sum() == 3
+    # fastest three are replicas 1 (1.0), 5 (2.0), 3 (3.0)
+    np.testing.assert_array_equal(flags, [0, 1, 0, 1, 0, 1, 0, 0])
+
+
+def test_quorum_exact_k_under_ties(topo8):
+    flags = _flags_for_times(topo8, [1.0] * 8, k=5)
+    assert flags.sum() == 5  # lexicographic (time, id) tie-break
+    np.testing.assert_array_equal(flags, [1, 1, 1, 1, 1, 0, 0, 0])
+
+
+def test_quorum_k_equals_n_is_full_sync(topo8):
+    flags = _flags_for_times(topo8, [3.0, 1.0, 4.0, 1.0, 5.0, 9.0, 2.0, 6.0], k=8)
+    assert flags.sum() == 8
+
+
+def test_timeout_flag():
+    assert float(policies.timeout_flag(jnp.float32(10.0), 50.0)) == 1.0
+    assert float(policies.timeout_flag(jnp.float32(51.0), 50.0)) == 0.0
+
+
+def test_resolve_aggregate_k():
+    assert policies.resolve_aggregate_k(SyncConfig(), 8) == 8  # -1 → n
+    assert policies.resolve_aggregate_k(
+        SyncConfig(num_replicas_to_aggregate=3), 8) == 3
+
+
+def test_straggler_profiles_deterministic():
+    root = prng.root_key(0)
+    for profile in ("none", "lognormal", "spike"):
+        cfg = SyncConfig(straggler_profile=profile)
+        a = float(policies.sample_step_time_ms(cfg, root, 3, 2, jnp.float32(0)))
+        b = float(policies.sample_step_time_ms(cfg, root, 3, 2, jnp.float32(0)))
+        assert a == b, profile
+    # continuous profiles vary step to step (spike only on spike steps)
+    for profile in ("none", "lognormal"):
+        cfg = SyncConfig(straggler_profile=profile)
+        a = float(policies.sample_step_time_ms(cfg, root, 3, 2, jnp.float32(0)))
+        c = float(policies.sample_step_time_ms(cfg, root, 4, 2, jnp.float32(0)))
+        assert a != c, f"{profile}: time must vary across steps"
+    # spike profile spikes at its configured rate
+    cfg = SyncConfig(straggler_profile="spike", straggler_spike_prob=0.3)
+    ts = [float(policies.sample_step_time_ms(cfg, root, s, 0, jnp.float32(0)))
+          for s in range(100)]
+    n_spikes = sum(t > cfg.straggler_mean_ms * 2 for t in ts)
+    assert 10 <= n_spikes <= 60
+
+
+def test_lognormal_profile_statistics():
+    cfg = SyncConfig(straggler_profile="lognormal", straggler_mean_ms=50.0,
+                     straggler_sigma=0.5)
+    root = prng.root_key(7)
+    samples = np.array([
+        float(policies.sample_step_time_ms(cfg, root, s, r, jnp.float32(0)))
+        for s in range(64) for r in range(8)])
+    assert samples.min() > 0
+    # mean-preserving lognormal: E[t] = mean_ms
+    assert 40.0 < samples.mean() < 60.0
+    # heavy right tail
+    assert np.percentile(samples, 99) > 2 * np.median(samples)
+
+
+def test_measured_time_feeds_through():
+    cfg = SyncConfig(straggler_profile="none")
+    root = prng.root_key(0)
+    t = float(policies.sample_step_time_ms(cfg, root, 0, 0, jnp.float32(123.0)))
+    assert 123.0 <= t < 123.01  # base + sub-microsecond jitter

@@ -1,0 +1,51 @@
+"""End-to-end training smoke tests on the 8-device CPU mesh — the
+integration-test role the reference delegated to a live EC2 cluster +
+evaluator process (SURVEY §4)."""
+
+import numpy as np
+
+from conftest import base_config
+
+
+def make_trainer(tmp_train_dir, synthetic_datasets, **over):
+    from distributedmnist_tpu.train.loop import Trainer
+    over.setdefault("train", {})
+    over["train"] = {"train_dir": tmp_train_dir, **over["train"]}
+    cfg = base_config(**over)
+    return Trainer(cfg, datasets=synthetic_datasets)
+
+
+def test_sync_training_reduces_loss(tmp_train_dir, synthetic_datasets):
+    t = make_trainer(tmp_train_dir, synthetic_datasets,
+                     train={"max_steps": 40, "log_every_steps": 10})
+    first = {}
+
+    def cb(step, rec):
+        if step == 1:
+            first.update(rec)
+
+    summary = t.run(step_callback=cb)
+    assert summary["final_step"] == 40
+    assert summary["updates_applied"] == 40
+    assert summary["last_metrics"]["loss"] < first["loss"]
+
+
+def test_convergence_oracle(tmp_train_dir, synthetic_datasets):
+    """Reaches ≥99% test accuracy — mirroring the reference's evaluator
+    oracle (src/nn_eval.py:95-103) as an automated assertion."""
+    t = make_trainer(tmp_train_dir, synthetic_datasets,
+                     train={"max_steps": 120, "log_every_steps": 40})
+    t.run()
+    result = t.evaluate("test")
+    assert result["accuracy"] >= 0.99, result
+    assert result["num_examples"] == synthetic_datasets.test.num_examples
+
+
+def test_metrics_shapes(tmp_train_dir, synthetic_datasets, topo8):
+    t = make_trainer(tmp_train_dir, synthetic_datasets,
+                     train={"max_steps": 3, "log_every_steps": 1})
+    summary = t.run()
+    m = t.collector.matrix()
+    assert m.shape == (3, topo8.num_replicas)
+    assert np.all(m >= 0)
+    assert summary["timing"]["barrier"]["count"] == 3
