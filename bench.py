@@ -43,15 +43,19 @@ def main() -> None:
     host_batch = {"image": ds.train.images[:batch], "label": ds.train.labels[:batch]}
     gbatch = topo.device_put_batch(host_batch)
 
-    warmup, timed = 10, 50
+    # Sync by FETCHING a scalar, not block_until_ready: on the tunneled
+    # TPU platform block_until_ready can return before the enqueued
+    # programs drain, which once inflated this number ~100x. A host
+    # transfer of an output scalar is an unambiguous queue drain.
+    warmup, timed = 10, 100
     for _ in range(warmup):
         state, metrics = step_fn(state, gbatch)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
 
     t0 = time.perf_counter()
     for _ in range(timed):
         state, metrics = step_fn(state, gbatch)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
     dt = time.perf_counter() - t0
 
     images_per_sec = timed * batch / dt

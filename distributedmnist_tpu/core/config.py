@@ -160,7 +160,11 @@ class EvalConfig:
 
     eval_interval_secs: float = 1.0
     eval_dir: str = "/tmp/dmt_eval"
-    eval_batch_size: int = 0  # 0 → full test set in one batch (nn_eval.py:121-122)
+    # 0 → auto: static batches of ≤4096 covering the full split. The
+    # reference instead builds its graph at batch = the whole 10k test
+    # set (nn_eval.py:121-122) — fixed-shape tiled batches are the
+    # TPU-native answer (no dynamic-shape recompile, bounded memory).
+    eval_batch_size: int = 0
     run_once: bool = False
     max_evals: int = 0  # 0 = unbounded
 

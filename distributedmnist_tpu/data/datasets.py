@@ -38,14 +38,17 @@ PIXEL_DEPTH = 255  # ≙ src/mnist.py:31
 
 @dataclasses.dataclass(frozen=True)
 class ArrayDataset:
-    """An in-memory split: images [N,H,W,C] float32 in [-0.5, 0.5],
-    labels [N] int32."""
+    """An in-memory split. For image tasks: images [N,H,W,C] float32 in
+    [-0.5, 0.5], labels [N] int32. For LM tasks: images [N,S] int32
+    token sequences, labels [N,S] (the same tokens — the loss shifts
+    internally for next-token prediction)."""
 
     images: np.ndarray
     labels: np.ndarray
 
     def __post_init__(self):
-        assert self.images.ndim == 4 and self.labels.ndim == 1
+        assert self.images.ndim in (2, 4), self.images.shape
+        assert self.labels.ndim in (1, 2), self.labels.shape
         assert len(self.images) == len(self.labels)
 
     @property
@@ -218,12 +221,40 @@ def make_synthetic(num_train: int, num_test: int, image_size: int = 28,
                     test=sample(num_test))
 
 
+def make_synthetic_lm(num_train: int, num_test: int, seq_len: int = 128,
+                      vocab_size: int = 256, seed: int = 12345,
+                      peak: float = 3.0) -> Datasets:
+    """Deterministic learnable token sequences for the long-context
+    (transformer) family: a fixed random first-order Markov chain with
+    peaked transitions. A causal LM that learns the transition table
+    drives next-token loss well below the unigram entropy — the
+    convergence oracle for the sequence path."""
+    rng = np.random.default_rng(seed)
+    logits = rng.standard_normal((vocab_size, vocab_size)) * peak
+    probs = np.exp(logits - logits.max(axis=1, keepdims=True))
+    probs /= probs.sum(axis=1, keepdims=True)
+    cdf = np.cumsum(probs, axis=1)
+
+    def sample(n: int) -> ArrayDataset:
+        seqs = np.empty((n, seq_len), np.int32)
+        seqs[:, 0] = rng.integers(0, vocab_size, n)
+        for t in range(1, seq_len):
+            u = rng.random(n)[:, None]
+            seqs[:, t] = (cdf[seqs[:, t - 1]] < u).sum(axis=1)
+        return ArrayDataset(seqs, seqs.copy())
+
+    return Datasets(train=sample(num_train),
+                    validation=sample(max(num_test // 2, 64)),
+                    test=sample(num_test))
+
+
 # --------------------------------------------------------------------------
 # registry entry point
 # --------------------------------------------------------------------------
 
 def load_datasets(cfg: DataConfig, image_size: int = 28, num_channels: int = 1,
-                  num_classes: int = 10) -> Datasets:
+                  num_classes: int = 10, seq_len: int = 128,
+                  vocab_size: int = 256) -> Datasets:
     """≙ load_mnist (src/mnist_data.py:212-213), generalized. Falls
     back to synthetic data when real files are absent (logged, never
     silent)."""
@@ -240,6 +271,10 @@ def load_datasets(cfg: DataConfig, image_size: int = 28, num_channels: int = 1,
         if name == "synthetic":
             return make_synthetic(cfg.synthetic_train_size, cfg.synthetic_test_size,
                                   image_size, num_channels, num_classes)
+        if name == "synthetic_lm":
+            return make_synthetic_lm(cfg.synthetic_train_size,
+                                     cfg.synthetic_test_size,
+                                     seq_len, vocab_size)
         raise ValueError(f"unknown dataset {name!r}")
     except FileNotFoundError as e:
         logger.warning("%s — falling back to synthetic data", e)

@@ -116,7 +116,8 @@ def eval_batches(data: ArrayDataset, batch_size: int, pad_multiple: int = 1,
         if pad:
             x = np.concatenate([x, np.zeros((pad,) + data.images.shape[1:],
                                             data.images.dtype)])
-            y = np.concatenate([y, np.zeros(pad, data.labels.dtype)])
+            y = np.concatenate([y, np.zeros((pad,) + data.labels.shape[1:],
+                                            data.labels.dtype)])
             w = np.concatenate([w, np.zeros(pad, np.float32)])
         yield {"image": x, "label": y, "weight": w}
 

@@ -20,16 +20,14 @@ from __future__ import annotations
 import time
 from pathlib import Path
 
-import jax
-
 from ..core.config import EvalConfig, ExperimentConfig
 from ..core.log import JsonlSink, eval_line, get_logger
 from ..core.mesh import Topology, make_topology
 from ..data.datasets import Datasets, load_datasets
-from ..data.pipeline import eval_batches
 from ..models.registry import get_model
 from ..parallel.api import build_eval_step, init_train_state
 from ..train import checkpoint as ckpt
+from ..train.evaluation import run_full_eval
 
 logger = get_logger("eval")
 
@@ -50,30 +48,33 @@ class Evaluator:
         self.model = get_model(cfg.model)
         self.datasets = datasets if datasets is not None else load_datasets(
             cfg.data, cfg.model.image_size, cfg.model.num_channels,
-            cfg.model.num_classes)
+            cfg.model.num_classes, cfg.model.seq_len, cfg.model.vocab_size)
         self.eval_fn = build_eval_step(self.model, cfg, self.topo)
         self.template = init_train_state(self.model, cfg)
         self.last_step_evaluated = -1
         self._sink: JsonlSink | None = None
 
     def _config_from_checkpoint(self) -> ExperimentConfig:
-        """Wait for the first checkpoint, then adopt its saved config."""
+        """Wait for the first checkpoint, then adopt its saved config.
+
+        Reads only the checkpoint's JSON ``extra`` payload — no state
+        template needed, so this works for any model/optimizer shape
+        (a resnet20/momentum/interval run, not just the default CNN)."""
         deadline = time.time() + 600.0
         while time.time() < deadline:
-            step = ckpt.latest_checkpoint_step(self.train_dir)
-            if step is not None:
-                from ..models.registry import get_model as _gm
-                from ..core.config import ExperimentConfig as EC
-                probe_cfg = EC()
-                template = init_train_state(_gm(probe_cfg.model), probe_cfg)
-                try:
-                    _, extra, _ = ckpt.restore_checkpoint(self.train_dir, template, step)
-                    if "config" in extra:
-                        return EC.from_dict(extra["config"])
-                except Exception:  # template mismatch — config still readable?
-                    pass
+            try:
+                out = ckpt.read_checkpoint_extra(self.train_dir)
+            except (OSError, ValueError, KeyError) as e:
+                # mid-replace read on a shared fs / torn file — this is
+                # a long-running service, retry on the next poll
+                logger.warning("checkpoint read failed (%s); retrying", e)
+                out = None
+            if out is not None:
+                extra, _ = out
+                if "config" in extra:
+                    return ExperimentConfig.from_dict(extra["config"])
                 logger.warning("checkpoint has no saved config; using defaults")
-                return EC()
+                return ExperimentConfig()
             time.sleep(1.0)
         raise TimeoutError(f"no checkpoint appeared in {self.train_dir} within 600s")
 
@@ -86,29 +87,18 @@ class Evaluator:
             return None
         state, _, at_step = restored
         params = self.topo.device_put_replicated(state.params)
-        data = self.datasets.test
-        n = self.topo.num_replicas
-        hosts = jax.process_count()
-        bs = self.eval_cfg.eval_batch_size or max(n, min(4096, data.num_examples))
-        t0 = time.time()
-        correct = loss_sum = weight = 0.0
-        for batch in eval_batches(data, bs, pad_multiple=max(1, n // hosts),
-                                  host_id=jax.process_index(), num_hosts=hosts):
-            c, l, w = self.eval_fn(params, self.topo.device_put_batch(batch))
-            correct += float(c)
-            loss_sum += float(l)
-            weight += float(w)
-        dt = time.time() - t0
+        out = run_full_eval(self.eval_fn, params, self.topo,
+                            self.datasets.test, self.eval_cfg.eval_batch_size)
         result = {
             "event": "eval", "step": at_step,
-            "num_examples": int(weight),
-            "precision_at_1": correct / max(weight, 1.0),
-            "loss": loss_sum / max(weight, 1.0),
-            "seconds": dt,
+            "num_examples": out["num_examples"],
+            "precision_at_1": out["accuracy"],
+            "loss": out["loss"],
+            "seconds": out["seconds"],
         }
         # the reference's exact parseable line (src/nn_eval.py:102-103)
         print(eval_line(result["num_examples"], result["precision_at_1"],
-                        result["loss"], dt), flush=True)
+                        result["loss"], result["seconds"]), flush=True)
         if self._sink:
             self._sink.write(result)
         return result

@@ -92,6 +92,20 @@ def _sgd(params: Any, grads: Any, momentum_bufs: Any, lr: jax.Array,
     return new_params, new_bufs
 
 
+def _gather_replicated(x: jax.Array, axis: str, n: int) -> jax.Array:
+    """All-gather a per-replica scalar into a REPLICATED [n] vector.
+
+    Expressed as a one-hot psum instead of ``lax.all_gather`` because
+    psum's output is statically known to be replicated over ``axis`` —
+    so it can leave shard_map under an out_spec of P() and every host
+    of a multi-host run holds the full vector (an all_gather result
+    stays marked device-varying and would need a sharded out_spec,
+    which non-addressable processes cannot materialize)."""
+    me = lax.axis_index(axis)
+    onehot = (jnp.arange(n) == me).astype(x.dtype)
+    return lax.psum(onehot * x, axis)
+
+
 def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
                      schedule: Schedule) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Compile the per-step SPMD training function.
@@ -177,18 +191,20 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
 
         new_state = new_state.replace(step=step + 1)
 
-        # --- metrics: scalars are replicated (psum-derived); per-
-        # replica series come out sharded over the replica axis and
-        # concatenate into global [n] vectors (≙ the CDF timing gossip,
-        # src/timeout_manager.py:48-61, with no RPC mesh at all) ------
+        # --- metrics: everything comes out REPLICATED (scalars via
+        # pmean/psum, per-replica series via all_gather) so every host
+        # holds the full [n] timing vector — a multi-host process can
+        # materialize its own copy without touching non-addressable
+        # shards (≙ the CDF timing gossip, src/timeout_manager.py:48-61,
+        # with no RPC mesh at all) ------------------------------------
         metrics = {
             "loss": lax.pmean(loss, axis),
             "train_acc": lax.pmean(train_acc, axis),
             "lr": schedule(state.updates_applied),
             "num_contributors": num_contrib,
             "updates_applied": new_state.updates_applied,
-            "step_times_ms": t_ms[None],  # [1] shard → [n] global
-            "flags": flag[None],          # [1] shard → [n] global
+            "step_times_ms": _gather_replicated(t_ms, axis, n),  # [n]
+            "flags": _gather_replicated(flag, axis, n),          # [n]
             "applied": applied,
         }
         return new_state, metrics
@@ -237,7 +253,7 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
     mesh = topo.mesh
     metrics_specs = {
         "loss": P(), "train_acc": P(), "lr": P(), "num_contributors": P(),
-        "updates_applied": P(), "step_times_ms": P(axis), "flags": P(axis),
+        "updates_applied": P(), "step_times_ms": P(), "flags": P(),
         "applied": P(),
     }
     sharded = jax.shard_map(

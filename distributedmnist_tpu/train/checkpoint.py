@@ -92,6 +92,24 @@ def latest_checkpoint_step(train_dir: str | Path) -> int | None:
     return int(ckpts[-1].stem.split("-")[1])
 
 
+def read_checkpoint_extra(train_dir: str | Path,
+                          step: int | None = None) -> tuple[dict, int] | None:
+    """Read only the JSON ``extra`` payload (saved config, data-iter
+    position) — needs NO state template, so the evaluator can bootstrap
+    its config from a checkpoint of *any* model/optimizer shape before
+    it knows what to build."""
+    train_dir = Path(train_dir)
+    if step is None:
+        step = latest_checkpoint_step(train_dir)
+        if step is None:
+            return None
+    payload = serialization.msgpack_restore(_ckpt_path(train_dir, step).read_bytes())
+    extra = payload.get("extra", {})
+    if isinstance(extra, (str, bytes)):
+        extra = json.loads(extra)
+    return extra, step
+
+
 def restore_checkpoint(train_dir: str | Path, template_state: Any,
                        step: int | None = None) -> tuple[Any, dict, int] | None:
     """Restore (state, extra, step); None when nothing exists

@@ -1,0 +1,53 @@
+"""Full-split evaluation — the single implementation behind both the
+in-loop ``Trainer.evaluate`` and the continuous evaluator service
+(≙ do_eval, src/nn_eval.py:49-115).
+
+Batches are static-shaped and weight-padded (pad examples carry weight
+0) so the jitted eval step compiles once; multi-host runs stripe the
+split across processes and psum the (correct, loss, weight) sums so
+every example is counted exactly once.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from ..core.mesh import Topology
+from ..data.pipeline import eval_batches
+
+
+def run_full_eval(eval_fn: Callable, params: Any, topo: Topology, data,
+                  batch_size: int = 0) -> dict[str, float]:
+    """Evaluate ``params`` on the whole split; returns accuracy / loss /
+    num_examples / seconds. ``batch_size`` 0 picks a throughput-friendly
+    default (≤4096, ≥1 row per replica)."""
+    n = topo.num_replicas
+    hosts = jax.process_count()
+    bs = batch_size or max(n, min(4096, data.num_examples))
+    t0 = time.time()
+    correct = loss_sum = weight = 0.0
+    num_examples = 0.0  # counted from batch weights: for LM models the
+    # eval_fn weight sum is a TOKEN count (lm_eval_metrics), which is
+    # the right normalizer for loss/accuracy but not an example count.
+    for batch in eval_batches(data, bs, pad_multiple=max(1, n // hosts),
+                              host_id=jax.process_index(), num_hosts=hosts):
+        num_examples += float(batch["weight"].sum())
+        c, l, w = eval_fn(params, topo.device_put_batch(batch))
+        correct += float(c)
+        loss_sum += float(l)
+        weight += float(w)
+    if hosts > 1:
+        # each host only iterated its stripe of the split
+        from jax.experimental import multihost_utils
+        num_examples = float(multihost_utils.process_allgather(
+            np.asarray(num_examples)).sum())
+    return {
+        "accuracy": correct / max(weight, 1.0),
+        "loss": loss_sum / max(weight, 1.0),
+        "num_examples": int(num_examples),
+        "seconds": time.time() - t0,
+    }

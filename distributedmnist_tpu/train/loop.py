@@ -19,12 +19,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import prng
 from ..core.config import ExperimentConfig
 from ..core.log import JsonlSink, get_logger, step_line
 from ..core.mesh import Topology, make_topology
 from ..data.datasets import Datasets, load_datasets
-from ..data.pipeline import eval_batches, make_train_iterator
+from ..data.pipeline import make_train_iterator
+from .evaluation import run_full_eval
 from ..models.registry import Model, get_model
 from ..obsv.timing import StepTimeCollector
 from ..parallel.api import (TrainState, build_eval_step, build_train_step,
@@ -45,7 +45,7 @@ class Trainer:
         self.model: Model = get_model(cfg.model)
         self.datasets = datasets if datasets is not None else load_datasets(
             cfg.data, cfg.model.image_size, cfg.model.num_channels,
-            cfg.model.num_classes)
+            cfg.model.num_classes, cfg.model.seq_len, cfg.model.vocab_size)
 
         n = self.topo.num_replicas
         if cfg.data.batch_size % n != 0:
@@ -131,21 +131,9 @@ class Trainer:
     def evaluate(self, split: str = "test") -> dict[str, float]:
         """One full-split eval pass (in-loop convenience; the
         continuous evaluator lives in ``evalsvc``)."""
-        data = getattr(self.datasets, split)
-        n = self.topo.num_replicas
-        hosts = jax.process_count()
-        bs = max(n, min(4096, data.num_examples))
-        correct = loss_sum = weight = 0.0
-        params = self.state.params
-        for batch in eval_batches(data, bs, pad_multiple=max(1, n // hosts),
-                                  host_id=jax.process_index(), num_hosts=hosts):
-            c, l, w = self.eval_fn(params, self.topo.device_put_batch(batch))
-            correct += float(c)
-            loss_sum += float(l)
-            weight += float(w)
-        return {"accuracy": correct / max(weight, 1.0),
-                "loss": loss_sum / max(weight, 1.0),
-                "num_examples": int(weight)}
+        return run_full_eval(self.eval_fn, self.state.params, self.topo,
+                             getattr(self.datasets, split),
+                             self.cfg.eval.eval_batch_size)
 
     def run(self, max_steps: int | None = None,
             step_callback: Callable[[int, dict], None] | None = None) -> dict[str, Any]:
