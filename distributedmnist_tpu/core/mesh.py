@@ -74,6 +74,15 @@ def simulate_devices(n: int) -> None:
     os.environ["XLA_FLAGS"] = flags
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     jax.config.update("jax_platforms", "cpu")
+    # XLA_FLAGS is parsed once per process; if a backend already
+    # initialized (axon registers one eagerly) the flag above is never
+    # re-read. jax_num_cpu_devices works post-hoc — but only after the
+    # stale backend is torn down, so callers in that state must
+    # clear_backends() BEFORE calling here (see __graft_entry__).
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except RuntimeError:
+        pass  # backend already initialized; XLA_FLAGS path applies
 
 
 @dataclasses.dataclass(frozen=True)

@@ -85,28 +85,36 @@ def _open_maybe_gz(path: Path):
     return open(path, "rb")
 
 
+def _read_idx_ubyte(path: Path, expect_ndim: int) -> np.ndarray:
+    """Raw idx(.gz) ubyte payload, via the native decoder when built."""
+    try:
+        from .native_loader import read_idx
+        arr = read_idx(path)
+    except (ImportError, ValueError):
+        with _open_maybe_gz(path) as f:
+            magic = struct.unpack(">HBB", f.read(4))
+            if magic[0] != 0 or magic[1] != 0x08:
+                raise ValueError(f"{path}: bad idx magic {magic}")
+            dims = struct.unpack(f">{magic[2]}I", f.read(4 * magic[2]))
+            buf = f.read(int(np.prod(dims)))
+        arr = np.frombuffer(buf, dtype=np.uint8).reshape(dims)
+    if arr.ndim != expect_ndim:
+        raise ValueError(f"{path}: expected {expect_ndim}-d idx, got {arr.ndim}-d")
+    return arr
+
+
 def read_idx_images(path: Path) -> np.ndarray:
     """Parse an idx3-ubyte image file → float32 [N,H,W,1] in [-0.5,0.5]
     (≙ extract_data, src/mnist_data.py:132-146)."""
-    with _open_maybe_gz(path) as f:
-        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
-        if magic != 2051:
-            raise ValueError(f"{path}: bad idx3 magic {magic}")
-        buf = f.read(n * rows * cols)
-    data = np.frombuffer(buf, dtype=np.uint8).astype(np.float32)
+    data = _read_idx_ubyte(path, 3).astype(np.float32)
     data = (data - PIXEL_DEPTH / 2.0) / PIXEL_DEPTH  # :142 parity
-    return data.reshape(n, rows, cols, 1)
+    return data[..., np.newaxis]
 
 
 def read_idx_labels(path: Path) -> np.ndarray:
     """Parse an idx1-ubyte label file (≙ extract_labels,
     src/mnist_data.py:147-155)."""
-    with _open_maybe_gz(path) as f:
-        magic, n = struct.unpack(">II", f.read(8))
-        if magic != 2049:
-            raise ValueError(f"{path}: bad idx1 magic {magic}")
-        buf = f.read(n)
-    return np.frombuffer(buf, dtype=np.uint8).astype(np.int32)
+    return _read_idx_ubyte(path, 1).astype(np.int32)
 
 
 _IDX_FILES = {

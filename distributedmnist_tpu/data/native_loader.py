@@ -1,0 +1,126 @@
+"""ctypes bindings for the native (C++) data pipeline.
+
+``NativePrefetcher`` is a drop-in replacement for
+:class:`~distributedmnist_tpu.data.pipeline.BatchIterator`: same batch
+shapes, same epoch/cursor checkpoint state, same drop-ragged-tail
+epoch semantics (≙ src/mnist_data.py:113-125) — but batch gathering
+and shuffling run in a C++ producer thread behind a bounded prefetch
+queue, so host batch assembly overlaps device execution. The shuffle
+stream is the library's own splitmix64 Fisher-Yates keyed on
+(seed, epoch): deterministic and resumable, though a *different*
+permutation than the numpy stream of the python iterator.
+
+Importing this module builds the library on first use; an unavailable
+toolchain surfaces as ImportError so `make_train_iterator`'s fallback
+catches it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from ..native import NativeBuildError, load_library
+from .pipeline import BatchIterator
+
+try:
+    _LIB = load_library()
+except NativeBuildError as e:  # degrade to the pure-python pipeline
+    raise ImportError(str(e)) from e
+
+
+def read_idx(path) -> np.ndarray:
+    """Decode an idx(.gz) file via the native reader (≙ the python
+    readers in data.datasets, which remain the fallback)."""
+    out_data = ctypes.POINTER(ctypes.c_uint8)()
+    ndim = ctypes.c_int32(0)
+    dims = (ctypes.c_int64 * 4)()
+    rc = _LIB.dml_read_idx(str(path).encode(), ctypes.byref(out_data),
+                           ctypes.byref(ndim), dims)
+    if rc != 0:
+        raise ValueError(f"native idx read of {path} failed (code {rc})")
+    shape = tuple(dims[i] for i in range(ndim.value))
+    try:
+        n = int(np.prod(shape))
+        arr = np.ctypeslib.as_array(out_data, shape=(n,)).copy().reshape(shape)
+    finally:
+        _LIB.dml_free(out_data)
+    return arr
+
+
+class NativePrefetcher:
+    """Wraps a fresh BatchIterator's dataset in the C++ prefetch loader."""
+
+    def __init__(self, it: BatchIterator, depth: int = 2):
+        self.local_batch = it.local_batch
+        # Keep contiguous copies alive for the lifetime of the handle —
+        # the C++ side borrows these buffers.
+        self._images = np.ascontiguousarray(it.data.images)
+        self._labels = np.ascontiguousarray(it.data.labels)
+        self._img_row = int(self._images.dtype.itemsize
+                            * np.prod(self._images.shape[1:], dtype=np.int64))
+        self._lab_row = int(self._labels.dtype.itemsize
+                            * np.prod(self._labels.shape[1:], dtype=np.int64))
+        self._handle = _LIB.dml_loader_create(
+            self._images.ctypes.data, self._labels.ctypes.data,
+            self._images.shape[0], self._img_row, self._lab_row,
+            self.local_batch, int(it.seed) & 0xFFFFFFFFFFFFFFFF,
+            max(1, int(depth)))
+        if not self._handle:
+            raise RuntimeError("dml_loader_create rejected its arguments")
+        self._epoch = 0
+        self._pos = 0
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> dict:
+        if not self._handle:
+            raise RuntimeError("NativePrefetcher is closed")
+        b = self.local_batch
+        images = np.empty((b,) + self._images.shape[1:], self._images.dtype)
+        labels = np.empty((b,) + self._labels.shape[1:], self._labels.dtype)
+        epoch = ctypes.c_int64(0)
+        pos = ctypes.c_int64(0)
+        rc = _LIB.dml_loader_next(self._handle, images.ctypes.data,
+                                  labels.ctypes.data, ctypes.byref(epoch),
+                                  ctypes.byref(pos))
+        if rc != 0:
+            raise RuntimeError("native loader stopped")
+        self._epoch, self._pos = epoch.value, pos.value
+        return {"image": images, "label": labels}
+
+    def state(self) -> dict:
+        """Checkpointable cursor of the last *consumed* batch, tagged
+        with the shuffle implementation (a cursor is only meaningful
+        within one permutation stream)."""
+        return {"impl": "native", "epoch": self._epoch, "pos": self._pos}
+
+    def restore(self, state: dict) -> None:
+        if not self._handle:
+            raise RuntimeError("NativePrefetcher is closed")
+        impl = state.get("impl", "numpy")
+        if impl != "native":
+            raise ValueError(
+                f"data-iterator state was produced by the {impl!r} pipeline; "
+                "restoring it into the native shuffle stream would replay a "
+                "different permutation")
+        self._epoch = int(state["epoch"])
+        self._pos = int(state["pos"])
+        _LIB.dml_loader_restore(self._handle, self._epoch, self._pos)
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            _LIB.dml_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

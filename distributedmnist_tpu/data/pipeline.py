@@ -73,10 +73,18 @@ class BatchIterator:
 
     def state(self) -> dict:
         """Checkpointable position (the reference cannot resume its
-        data stream; we can)."""
-        return {"epoch": self._epoch, "pos": self._pos}
+        data stream; we can). Tagged with the shuffle implementation:
+        an (epoch, pos) cursor only identifies a stream position within
+        ONE permutation sequence."""
+        return {"impl": "numpy", "epoch": self._epoch, "pos": self._pos}
 
     def restore(self, state: dict) -> None:
+        impl = state.get("impl", "numpy")
+        if impl != "numpy":
+            raise ValueError(
+                f"data-iterator state was produced by the {impl!r} pipeline; "
+                "restoring it into the numpy shuffle stream would replay a "
+                "different permutation")
         self._epoch = int(state["epoch"])
         self._order = self._epoch_order(self._epoch)
         self._pos = int(state["pos"])

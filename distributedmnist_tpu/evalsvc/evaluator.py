@@ -82,7 +82,16 @@ class Evaluator:
 
     def evaluate_checkpoint(self, step: int | None = None) -> dict | None:
         """Evaluate one checkpoint (≙ do_eval, src/nn_eval.py:49-115)."""
-        restored = ckpt.restore_checkpoint(self.train_dir, self.template, step)
+        try:
+            restored = ckpt.restore_checkpoint(self.train_dir, self.template,
+                                               step)
+        except (OSError, ValueError, KeyError) as e:
+            # The trainer's checkpoint GC can unlink this step between
+            # our latest_checkpoint_step poll and the read (or a shared
+            # fs serves a torn file). Skip; the next poll sees a newer one.
+            logger.warning("checkpoint step=%s unreadable (%s); skipping",
+                           step, e)
+            return None
         if restored is None:
             return None
         state, _, at_step = restored

@@ -1,0 +1,93 @@
+"""Native (C++) runtime components.
+
+The reference framework's native substrate is the TF 1.x C++ runtime
+it imports (SURVEY §2.3); this package is ours. The library is built
+on demand from :file:`dml_native.cc` with the system ``g++`` (no
+pybind11 in this image — the ABI is plain C, consumed via ctypes) and
+cached in ``_build/``; rebuilt automatically when the source is newer
+than the cached object.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+_SRC = Path(__file__).resolve().parent / "dml_native.cc"
+_BUILD_DIR = Path(__file__).resolve().parent / "_build"
+_LIB_PATH = _BUILD_DIR / "libdml_native.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+
+class NativeBuildError(RuntimeError):
+    """g++ compile of the native library failed."""
+
+
+def _build() -> None:
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    tmp = _BUILD_DIR / f".libdml_native.{os.getpid()}.tmp.so"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", str(_SRC),
+           "-o", str(tmp), "-lz", "-pthread"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise NativeBuildError(f"failed to run g++: {e}") from e
+    if proc.returncode != 0:
+        tmp.unlink(missing_ok=True)
+        raise NativeBuildError(
+            f"g++ failed ({proc.returncode}):\n{proc.stderr[-2000:]}")
+    os.replace(tmp, _LIB_PATH)  # atomic: concurrent builders both win
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    lib.dml_free.argtypes = [c.c_void_p]
+    lib.dml_free.restype = None
+
+    lib.dml_read_idx.argtypes = [
+        c.c_char_p, c.POINTER(c.POINTER(c.c_uint8)),
+        c.POINTER(c.c_int32), c.POINTER(c.c_int64)]
+    lib.dml_read_idx.restype = c.c_int
+
+    lib.dml_loader_create.argtypes = [
+        c.c_void_p, c.c_void_p, c.c_int64, c.c_int64, c.c_int64, c.c_int64,
+        c.c_uint64, c.c_int32]
+    lib.dml_loader_create.restype = c.c_void_p
+
+    lib.dml_loader_next.argtypes = [
+        c.c_void_p, c.c_void_p, c.c_void_p,
+        c.POINTER(c.c_int64), c.POINTER(c.c_int64)]
+    lib.dml_loader_next.restype = c.c_int
+
+    lib.dml_loader_restore.argtypes = [c.c_void_p, c.c_int64, c.c_int64]
+    lib.dml_loader_restore.restype = None
+
+    lib.dml_loader_destroy.argtypes = [c.c_void_p]
+    lib.dml_loader_destroy.restype = None
+    return lib
+
+
+def load_library() -> ctypes.CDLL:
+    """Build (if stale/missing) and load the native library.
+
+    Raises NativeBuildError when the toolchain is unavailable; callers
+    degrade to the pure-python path (data.pipeline.make_train_iterator).
+    """
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if (not _LIB_PATH.exists()
+                or _LIB_PATH.stat().st_mtime < _SRC.stat().st_mtime):
+            _build()
+        try:
+            lib = ctypes.CDLL(str(_LIB_PATH))
+        except OSError as e:
+            raise NativeBuildError(f"could not load {_LIB_PATH}: {e}") from e
+        _lib = _bind(lib)
+        return _lib

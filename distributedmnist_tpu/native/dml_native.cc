@@ -1,0 +1,261 @@
+// Native data-pipeline runtime for distributedmnist_tpu.
+//
+// The reference delegates its data/queue machinery to the TF 1.x C++
+// runtime: FIFOQueue kernels feed the token barrier and input pipeline
+// (reference: sync_replicas_optimizer_modified.py:199-206; the Python
+// DataSet.next_batch at src/mnist_data.py:102-130 is the only
+// first-party data code). This library is the framework's own native
+// substrate for that capability: idx(.gz) decoding, a seeded
+// per-epoch Fisher-Yates shuffle, and a background producer thread
+// feeding a bounded batch queue (the FIFOQueue equivalent) so host
+// batch assembly overlaps device execution.
+//
+// Exposed as a plain C ABI consumed via ctypes
+// (distributedmnist_tpu/data/native_loader.py). ctypes releases the
+// GIL for foreign calls, so the blocking dml_loader_next overlaps
+// Python-side work.
+
+#include <algorithm>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <new>
+#include <thread>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+// splitmix64: tiny, well-mixed, deterministic across platforms.
+uint64_t splitmix64(uint64_t* s) {
+  uint64_t z = (*s += 0x9e3779b97f4a7c15ull);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+  return z ^ (z >> 31);
+}
+
+int read_exact(gzFile f, void* buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    unsigned chunk = static_cast<unsigned>(
+        std::min<size_t>(n - got, 1u << 30));
+    int r = gzread(f, static_cast<char*>(buf) + got, chunk);
+    if (r <= 0) return -1;
+    got += static_cast<size_t>(r);
+  }
+  return 0;
+}
+
+struct Batch {
+  std::vector<uint8_t> images, labels;
+  int64_t epoch = 0, pos_after = 0;
+};
+
+// The prefetching loader. Rows are opaque byte strips, so any
+// (dtype, shape) pair works: float32 image tensors and int32 token
+// sequences alike. Producer-side state (epoch/pos/order) is owned by
+// the worker thread; restore() joins the thread before touching it.
+struct Loader {
+  const uint8_t* images = nullptr;  // borrowed; Python keeps them alive
+  const uint8_t* labels = nullptr;
+  int64_t n = 0, img_row = 0, lab_row = 0, batch = 0;
+  uint64_t seed = 0;
+  size_t depth = 2;
+
+  std::vector<int64_t> order;
+  int64_t epoch = 0, pos = 0;
+
+  std::mutex mu;
+  std::condition_variable cv_space, cv_data;
+  std::deque<Batch> q;
+  bool stopping = false;
+  std::thread worker;
+
+  // Deterministic permutation for (seed, epoch) — the reference
+  // reshuffles per epoch with a *time* seed (src/mnist_data.py:55,
+  // 80-84,113-125); here the stream is replayable.
+  void shuffle_for(int64_t ep) {
+    order.resize(static_cast<size_t>(n));
+    for (int64_t i = 0; i < n; ++i) order[static_cast<size_t>(i)] = i;
+    uint64_t s = seed ^ (0x9e3779b97f4a7c15ull * static_cast<uint64_t>(ep + 1));
+    splitmix64(&s);  // decorrelate nearby (seed, epoch) pairs
+    for (int64_t i = n - 1; i > 0; --i) {
+      uint64_t j = splitmix64(&s) % static_cast<uint64_t>(i + 1);
+      std::swap(order[static_cast<size_t>(i)], order[static_cast<size_t>(j)]);
+    }
+  }
+
+  void produce() {
+    for (;;) {
+      if (pos + batch > n) {  // drop ragged tail, reshuffle
+        epoch += 1;
+        shuffle_for(epoch);
+        pos = 0;
+      }
+      Batch b;
+      b.images.resize(static_cast<size_t>(img_row * batch));
+      b.labels.resize(static_cast<size_t>(lab_row * batch));
+      for (int64_t i = 0; i < batch; ++i) {
+        int64_t src = order[static_cast<size_t>(pos + i)];
+        std::memcpy(b.images.data() + i * img_row, images + src * img_row,
+                    static_cast<size_t>(img_row));
+        std::memcpy(b.labels.data() + i * lab_row, labels + src * lab_row,
+                    static_cast<size_t>(lab_row));
+      }
+      pos += batch;
+      b.epoch = epoch;
+      b.pos_after = pos;
+      std::unique_lock<std::mutex> lk(mu);
+      cv_space.wait(lk, [&] { return stopping || q.size() < depth; });
+      if (stopping) return;
+      q.push_back(std::move(b));
+      cv_data.notify_one();
+    }
+  }
+
+  void start() {
+    stopping = false;
+    worker = std::thread([this] { produce(); });
+  }
+
+  void stop() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stopping = true;
+    }
+    cv_space.notify_all();
+    cv_data.notify_all();
+    if (worker.joinable()) worker.join();
+    q.clear();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void dml_free(void* p) { std::free(p); }
+
+// idx(.gz) reader (the MNIST/Fashion-MNIST container; zlib's gzopen
+// transparently handles both compressed and raw files). ubyte payloads
+// only (type code 0x08 — what the format's datasets use). Returns 0 on
+// success; *out_data is malloc'd and must be released via dml_free.
+int dml_read_idx(const char* path, uint8_t** out_data, int32_t* out_ndim,
+                 int64_t* out_dims /* capacity >= 4 */) {
+  gzFile f = gzopen(path, "rb");
+  if (!f) return -1;
+  uint8_t magic[4];
+  if (read_exact(f, magic, 4) != 0 || magic[0] != 0 || magic[1] != 0 ||
+      magic[2] != 0x08) {
+    gzclose(f);
+    return -2;
+  }
+  int nd = magic[3];
+  if (nd < 1 || nd > 4) {
+    gzclose(f);
+    return -3;
+  }
+  int64_t total = 1;
+  for (int i = 0; i < nd; ++i) {
+    uint8_t b[4];
+    if (read_exact(f, b, 4) != 0) {
+      gzclose(f);
+      return -4;
+    }
+    int64_t d = (static_cast<int64_t>(b[0]) << 24) |
+                (static_cast<int64_t>(b[1]) << 16) |
+                (static_cast<int64_t>(b[2]) << 8) | b[3];
+    if (d <= 0) {
+      gzclose(f);
+      return -5;
+    }
+    out_dims[i] = d;
+    total *= d;
+  }
+  uint8_t* data = static_cast<uint8_t*>(std::malloc(static_cast<size_t>(total)));
+  if (!data) {
+    gzclose(f);
+    return -6;
+  }
+  if (read_exact(f, data, static_cast<size_t>(total)) != 0) {
+    std::free(data);
+    gzclose(f);
+    return -7;
+  }
+  gzclose(f);
+  *out_data = data;
+  *out_ndim = nd;
+  return 0;
+}
+
+void* dml_loader_create(const void* images, const void* labels,
+                        int64_t num_examples, int64_t image_row_bytes,
+                        int64_t label_row_bytes, int64_t batch_size,
+                        uint64_t seed, int32_t depth) {
+  if (!images || !labels || num_examples <= 0 || batch_size <= 0 ||
+      batch_size > num_examples || image_row_bytes <= 0 ||
+      label_row_bytes <= 0 || depth < 1)
+    return nullptr;
+  Loader* L = new (std::nothrow) Loader();
+  if (!L) return nullptr;
+  L->images = static_cast<const uint8_t*>(images);
+  L->labels = static_cast<const uint8_t*>(labels);
+  L->n = num_examples;
+  L->img_row = image_row_bytes;
+  L->lab_row = label_row_bytes;
+  L->batch = batch_size;
+  L->seed = seed;
+  L->depth = static_cast<size_t>(depth);
+  L->shuffle_for(0);
+  L->start();
+  return L;
+}
+
+// Blocking pop of the next prefetched batch into caller buffers
+// (batch_size * row_bytes each). out_epoch/out_pos report the stream
+// position *after* this batch — the checkpointable cursor.
+int dml_loader_next(void* loader, void* out_images, void* out_labels,
+                    int64_t* out_epoch, int64_t* out_pos) {
+  Loader* L = static_cast<Loader*>(loader);
+  if (!L || !out_images || !out_labels) return -2;
+  Batch b;
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->cv_data.wait(lk, [&] { return L->stopping || !L->q.empty(); });
+    if (L->stopping) return -1;
+    b = std::move(L->q.front());
+    L->q.pop_front();
+  }
+  L->cv_space.notify_one();
+  std::memcpy(out_images, b.images.data(), b.images.size());
+  std::memcpy(out_labels, b.labels.data(), b.labels.size());
+  if (out_epoch) *out_epoch = b.epoch;
+  if (out_pos) *out_pos = b.pos_after;
+  return 0;
+}
+
+// Reposition the stream to (epoch, pos) — exact resume of the
+// deterministic shuffle stream (the reference cannot resume its data
+// stream at all; its shuffle is time-seeded).
+void dml_loader_restore(void* loader, int64_t epoch, int64_t pos) {
+  Loader* L = static_cast<Loader*>(loader);
+  if (!L) return;
+  L->stop();
+  L->epoch = epoch < 0 ? 0 : epoch;
+  L->shuffle_for(L->epoch);
+  L->pos = pos < 0 ? 0 : (pos > L->n ? L->n : pos);
+  L->start();
+}
+
+void dml_loader_destroy(void* loader) {
+  Loader* L = static_cast<Loader*>(loader);
+  if (!L) return;
+  L->stop();
+  delete L;
+}
+
+}  // extern "C"
