@@ -67,6 +67,9 @@ class ModelConfig:
     num_heads: int = 4
     num_layers: int = 2
     vocab_size: int = 256
+    # "flash": fused pallas kernel (ops/pallas_attention; interpreted
+    # off-TPU), "dense": XLA einsum attention.
+    attention_impl: str = "flash"
 
 
 @dataclass(frozen=True)

@@ -138,9 +138,18 @@ def _transformer(cfg: ModelConfig) -> Model:
             num_heads=cfg.num_heads, num_layers=cfg.num_layers,
             max_seq_len=cfg.seq_len)
 
+    if cfg.attention_impl == "flash":
+        from ..ops.pallas_attention import flash_attention
+        attention_fn = flash_attention
+    elif cfg.attention_impl == "dense":
+        attention_fn = None  # transformer defaults to local_self_attention
+    else:
+        raise ValueError(f"unknown attention_impl {cfg.attention_impl!r}")
+
     def apply(params, x, *, train=False, dropout_key=None):
         del dropout_key
         return transformer.apply(params, x, num_heads=cfg.num_heads,
+                                 attention_fn=attention_fn,
                                  compute_dtype=compute_dtype)
 
     return Model(name=cfg.name, init=init, apply=apply,

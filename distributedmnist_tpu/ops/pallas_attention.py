@@ -1,0 +1,267 @@
+"""Fused flash attention as a Pallas TPU kernel.
+
+The hot op of the long-context model family. XLA's dense-attention
+lowering materializes the [s, s] score matrix in HBM; this kernel
+streams K/V blocks through VMEM with an online-softmax accumulator, so
+HBM traffic stays O(s·d) and the two matmuls per block ride the MXU
+back-to-back without leaving the chip.
+
+The reference has no attention at all (SURVEY §5.7; fixed 28×28 inputs,
+reference src/mnist.py:27-30) — this is framework capability, not
+parity. Composes with the sequence-parallel strategies:
+
+* single-device / data-parallel: drop-in ``attention_fn`` for
+  models.transformer.
+* Ulysses (ops/ulysses_attention): after the all-to-all each device
+  holds full sequences for a head subset — exactly this kernel's shape.
+* ring (ops/ring_attention): keeps its own psum-free online-softmax
+  accumulator across ppermute steps.
+
+Grid = (batch·heads, q blocks, k blocks); the k dimension is
+"arbitrary" (sequential), so the f32 accumulator/max/denominator live
+in VMEM scratch across k steps and outputs are written once at the
+final k block. Head dim and sequence are padded to lane/block
+multiples and masked, so any (s, d) works.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30  # finite: keeps exp() algebra NaN-free on padded rows
+
+_LANE = 128
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                 scale: float, causal: bool, block_q: int, block_k: int,
+                 seq_len: int):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Blocks strictly above the causal diagonal contribute nothing.
+    live = (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)   # [bq, dp]
+        k = k_ref[0].astype(jnp.float32)   # [bk, dp]
+        v = v_ref[0].astype(jnp.float32)   # [bk, dp]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        kpos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = kpos < seq_len  # padded keys never attend
+        if causal:
+            qpos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask &= qpos >= kpos
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                       # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)               # [bq, 1]
+        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
+             scale: float, block_q: int, block_k: int,
+             interpret: bool) -> jax.Array:
+    b, h, s, d = q.shape
+    block_q = min(block_q, max(s, 1))
+    block_k = min(block_k, max(s, 1))
+
+    import math
+
+    def prep(x):
+        x = x.reshape(b * h, s, d)
+        x = _pad_to(x, 2, _LANE)
+        # lcm so BOTH grids tile the padded sequence exactly
+        return _pad_to(x, 1, math.lcm(block_q, block_k))
+
+    qp, kp, vp = prep(q), prep(k), prep(v)
+    bh, sp, dp = qp.shape
+    nq, nk = sp // block_q, sp // block_k
+
+    kernel = functools.partial(
+        _attn_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_len=s)
+    # Under shard_map (check_vma) the output must declare which mesh
+    # axes it varies over — the union of the inputs' varying axes.
+    vma = frozenset()
+    for x in (qp, kp, vp):
+        vma |= getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+    out_sds = (jax.ShapeDtypeStruct((bh, sp, dp), q.dtype, vma=vma) if vma
+               else jax.ShapeDtypeStruct((bh, sp, dp), q.dtype))
+    out = pl.pallas_call(
+        kernel,
+        out_shape=out_sds,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda ib, iq, ik: (ib, iq, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda ib, iq, ik: (ib, ik, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda ib, iq, ik: (ib, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dp), lambda ib, iq, ik: (ib, iq, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, dp), jnp.float32),     # acc
+            pltpu.VMEM((block_q, _LANE), jnp.float32),  # running max
+            pltpu.VMEM((block_q, _LANE), jnp.float32),  # running denom
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :s, :d].reshape(b, h, s, d)
+
+
+# ---------------------------------------------------------------------------
+# Backward: flash-style blockwise VJP. The pallas forward isn't
+# auto-differentiable (scratch accumulators), so the gradient is a
+# custom VJP that recomputes scores block-by-block in f32 — residuals
+# stay O(s·d) (q, k, v, out only; the [s, s] score matrix is never
+# materialized). Expressed in jnp/lax.scan so XLA fuses it; a dedicated
+# backward pallas kernel is a later optimization.
+# ---------------------------------------------------------------------------
+
+def _bwd_blockwise(q, k, v, out, dout, causal: bool, scale: float,
+                   block: int):
+    b, h, s, d = q.shape
+    f32 = jnp.float32
+    q32, k32, v32, o32, do32 = (x.astype(f32) for x in (q, k, v, out, dout))
+    kp = _pad_to(k32, 2, block)
+    vp = _pad_to(v32, 2, block)
+    sp = kp.shape[2]
+    nblk = sp // block
+    kpos_base = jnp.arange(block)
+    qpos = jnp.arange(s)[:, None]                       # [s, 1]
+    delta = jnp.sum(do32 * o32, axis=-1, keepdims=True)  # [b,h,s,1]
+
+    def scores(jblk):
+        kj = lax.dynamic_slice_in_dim(kp, jblk * block, block, axis=2)
+        sij = jnp.einsum("bhqd,bhkd->bhqk", q32, kj) * scale
+        kpos = jblk * block + kpos_base[None, :]
+        mask = kpos < s
+        if causal:
+            mask = mask & (qpos >= kpos)
+        return jnp.where(mask, sij, _NEG_INF), kj
+
+    # pass 1: log-sum-exp per query row, streaming over k blocks
+    def lse_step(carry, jblk):
+        m, l = carry
+        sij, _ = scores(jblk)
+        m_new = jnp.maximum(m, jnp.max(sij, axis=-1, keepdims=True))
+        l = l * jnp.exp(m - m_new) + jnp.sum(jnp.exp(sij - m_new), -1,
+                                             keepdims=True)
+        return (m_new, l), None
+
+    m0 = jnp.full((b, h, s, 1), _NEG_INF, f32)
+    l0 = jnp.zeros((b, h, s, 1), f32)
+    dq0 = jnp.zeros_like(q32)
+
+    # Under shard_map, scan carries must match the loop outputs' varying
+    # axes (which inherit from the sharded q/k/v).
+    def match_vma(x):
+        want = getattr(jax.typeof(q32), "vma", frozenset()) or frozenset()
+        have = getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+        missing = tuple(want - have)
+        return lax.pvary(x, missing) if missing else x
+
+    m0, l0, dq0 = (match_vma(x) for x in (m0, l0, dq0))
+    (m, l), _ = lax.scan(lse_step, (m0, l0), jnp.arange(nblk))
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+
+    # pass 2: dq accumulates across blocks; dk/dv are per-block
+    def bwd_step(dq, jblk):
+        sij, kj = scores(jblk)
+        vj = lax.dynamic_slice_in_dim(vp, jblk * block, block, axis=2)
+        p = jnp.exp(sij - lse)                            # masked → 0
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do32, vj)
+        ds = p * (dp - delta)
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kj) * scale
+        dkj = jnp.einsum("bhqk,bhqd->bhkd", ds, q32) * scale
+        dvj = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
+        return dq, (dkj, dvj)
+
+    dq, (dk_blocks, dv_blocks) = lax.scan(bwd_step, dq0, jnp.arange(nblk))
+
+    def unblock(blocks):  # [nblk, b, h, block, d] → [b, h, s, d]
+        x = jnp.moveaxis(blocks, 0, 2)          # [b, h, nblk, block, d]
+        return x.reshape(b, h, sp, d)[:, :, :s]
+
+    return (dq.astype(q.dtype), unblock(dk_blocks).astype(k.dtype),
+            unblock(dv_blocks).astype(v.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _forward(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, dout):
+    q, k, v, out = res
+    return _bwd_blockwise(q, k, v, out, dout, causal, scale, block_k)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "interpret"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True, scale: float | None = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool | None = None) -> jax.Array:
+    """Exact attention, flash-style. q/k/v: [batch, heads, seq, head_dim]
+    (self-attention: one shared seq length). Returns q-shaped output.
+    Differentiable (custom blockwise VJP).
+
+    ``interpret=None`` auto-selects: compiled kernel on TPU, pallas
+    interpreter elsewhere (the CPU test path).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, h, s, d = q.shape
+    assert k.shape == v.shape == (b, h, s, d), (q.shape, k.shape, v.shape)
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)

@@ -1,0 +1,60 @@
+"""Ulysses-style sequence parallelism: all-to-all head scatter.
+
+The second sequence-parallel strategy next to ring attention
+(ops/ring_attention.py). Where the ring rotates K/V blocks around
+``ppermute`` neighbor links, Ulysses re-shards: an ``all_to_all``
+trades the sequence sharding for a *head* sharding, every device runs
+exact attention over the FULL sequence for its head subset — the
+perfect shape for the fused pallas kernel (ops/pallas_attention.py) —
+and a second ``all_to_all`` restores the sequence sharding.
+
+Trade-offs (why both exist): Ulysses moves 2× the activations through
+all-to-all but runs attention unblocked and needs ``heads %
+n_devices == 0``; the ring streams K/V with O(1) extra memory and
+works for any head count, but serializes into n ppermute steps. Cf.
+DeepSpeed-Ulysses (arXiv:2309.14509) vs Ring Attention
+(arXiv:2310.01889). The reference has no sequence dimension at all
+(SURVEY §5.7) — this is framework capability beyond parity.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax import lax
+
+from .ring_attention import local_self_attention
+
+
+def ulysses_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                           axis_name: str, *, causal: bool = True,
+                           scale: float | None = None,
+                           attention_fn: Callable | None = None) -> jax.Array:
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    Args (local blocks inside shard_map):
+      q, k, v: [batch, heads, seq_local, head_dim]; ``heads`` must be
+        divisible by the axis size.
+      attention_fn: full-sequence attention applied per head subset —
+        defaults to the dense oracle; pass
+        ``pallas_attention.flash_attention`` for the fused kernel.
+
+    Returns [batch, heads, seq_local, head_dim] for this device's block.
+    """
+    n = lax.axis_size(axis_name)
+    h = q.shape[1]
+    if h % n != 0:
+        raise ValueError(f"heads={h} not divisible by axis {axis_name!r} "
+                         f"size {n} (use ring attention instead)")
+    inner = attention_fn or local_self_attention
+
+    def gather_seq(x):  # [b, h, s/n, d] → [b, h/n, s, d]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    o = inner(gather_seq(q), gather_seq(k), gather_seq(v), causal=causal,
+              scale=scale)
+    # [b, h/n, s, d] → [b, h, s/n, d]
+    return lax.all_to_all(o, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
