@@ -99,7 +99,7 @@ class Evaluator:
         out = run_full_eval(self.eval_fn, params, self.topo,
                             self.datasets.test, self.eval_cfg.eval_batch_size)
         result = {
-            "event": "eval", "step": at_step,
+            "event": "eval", "step": at_step, "time": time.time(),
             "num_examples": out["num_examples"],
             "precision_at_1": out["accuracy"],
             "loss": out["loss"],

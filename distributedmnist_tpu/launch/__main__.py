@@ -8,6 +8,7 @@ host runs the same program — so the CLI reduces to:
   python -m distributedmnist_tpu.launch train --config cfg.json [k=v ...]
   python -m distributedmnist_tpu.launch eval  --train_dir DIR
   python -m distributedmnist_tpu.launch sweep --configs DIR --results DIR
+  python -m distributedmnist_tpu.launch report --train_dir DIR --out DIR
   python -m distributedmnist_tpu.launch devices
 
 Dotted overrides (``sync.mode=quorum``) take the place of the ~25
@@ -61,6 +62,14 @@ def _sweep(args) -> None:
                       for r in records]))
 
 
+def _report(args) -> None:
+    from ..obsv.report import generate_report
+
+    stats = generate_report(args.train_dir, args.eval_dir, args.out,
+                            name=args.name)
+    print(json.dumps(stats, indent=2))
+
+
 def _devices(_args) -> None:
     """≙ list_running_instances (tools/tf_ec2.py:371-402) — but the
     'cluster' is whatever mesh JAX sees."""
@@ -98,8 +107,25 @@ def main(argv=None) -> None:
     ps.add_argument("--only", default=None, help="comma-separated names")
     ps.set_defaults(fn=_sweep)
 
+    pr = sub.add_parser("report", help="figures + stats from run logs")
+    pr.add_argument("--train_dir", required=True)
+    pr.add_argument("--eval_dir", default=None)
+    pr.add_argument("--out", required=True)
+    pr.add_argument("--name", default="experiment")
+    pr.set_defaults(fn=_report)
+
     pd = sub.add_parser("devices", help="show mesh topology")
     pd.set_defaults(fn=_devices)
+
+    pp = sub.add_parser("pod", help="TPU pod-slice lifecycle (gcloud)",
+                        add_help=False)
+    pp.set_defaults(fn=None)
+
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "pod":  # delegate the full sub-argv
+        from .pod import main as pod_main
+        return pod_main(argv[1:])
 
     args = p.parse_args(argv)
     args.fn(args)

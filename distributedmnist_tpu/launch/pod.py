@@ -1,0 +1,245 @@
+"""TPU pod-slice lifecycle management.
+
+≙ the reference's EC2 orchestrator ``tools/tf_ec2.py`` — boto3 spot
+launches, paramiko SSH fan-out, role templating, NFS setup, SCP
+downloads, and an 11-subcommand dispatch (:828-856). On Cloud TPU the
+shape collapses: a pod slice is ONE resource (no per-role instances —
+every host runs the same SPMD program, so the reference's
+PS_HOSTS/WORKER_HOSTS/TASK_ID/JOB_NAME templating, :493-534,
+disappears), SSH fan-out is ``gcloud compute tpus tpu-vm ssh
+--worker=all``, and downloads are ``gcloud ... scp``.
+
+Subcommand parity map (reference dispatch table → here):
+
+  launch                 → create            (tf_ec2.py:796, :237-271)
+  shutdown               → delete            (:440)
+  clean_launch_and_run   → clean-launch-run  (:806)
+  run_tf                 → run               (:445)
+  kill_all_python        → kill-all          (:637)
+  kill_python            → kill-all --worker (:617)
+  list_idle_instances    → status (idle = no python running, :371-402)
+  list_running_instances → status            (:404)
+  run_command            → exec              (:841)
+  download_outdir        → download          (:651-697)
+  download_file          → download --file   (:699-742)
+
+Every action goes through a ``Runner`` that either executes the
+``gcloud`` CLI or records the exact argv (dry-run) — the test seam,
+and also how a human can audit what would run. No cloud SDK is
+imported; environments without ``gcloud`` get a clear error only when
+a command is actually executed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import shlex
+import subprocess
+from pathlib import Path
+from typing import Any, Sequence
+
+from ..core.log import get_logger
+
+logger = get_logger("pod")
+
+
+class PodError(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class PodConfig:
+    """Declarative slice description (≙ the cluster_specs half of a
+    ``Cfg`` literal, tools/tf_ec2.py:27-147 — as safe JSON, not
+    eval()'d python)."""
+
+    name: str = "dmt-pod"
+    zone: str = "us-central2-b"
+    accelerator_type: str = "v4-8"
+    runtime_version: str = "tpu-ubuntu2204-base"
+    project: str | None = None
+    spot: bool = False                      # ≙ spot-instance launch path
+    setup_command: str = ""                 # run once after create
+    train_command: str = ("python -m distributedmnist_tpu.launch train "
+                          "--config configs/basic.json")
+    remote_outdir: str = "/tmp/dmt_train"   # ≙ Cfg nfs_mount_point outdir
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_file(cls, path: str | Path) -> "PodConfig":
+        d = json.loads(Path(path).read_text())
+        unknown = set(d) - {f.name for f in dataclasses.fields(cls)}
+        if unknown:
+            raise PodError(f"unknown pod config keys: {sorted(unknown)}")
+        return cls(**d)
+
+
+class Runner:
+    """Executes argv lists, or records them under dry_run."""
+
+    def __init__(self, dry_run: bool = False):
+        self.dry_run = dry_run
+        self.recorded: list[list[str]] = []
+
+    def run(self, argv: Sequence[str], check: bool = True,
+            capture: bool = False) -> subprocess.CompletedProcess | None:
+        argv = list(argv)
+        self.recorded.append(argv)
+        if self.dry_run:
+            logger.info("DRY-RUN: %s", shlex.join(argv))
+            return None
+        try:
+            return subprocess.run(argv, check=check, text=True,
+                                  capture_output=capture)
+        except FileNotFoundError as e:
+            raise PodError(
+                f"{argv[0]!r} not found — pod management needs the gcloud "
+                "CLI on PATH (or use --dry-run to inspect commands)") from e
+        except subprocess.CalledProcessError as e:
+            raise PodError(f"command failed ({e.returncode}): "
+                           f"{shlex.join(argv)}") from e
+
+
+class PodManager:
+    """All pod actions as methods; argv construction is pure, so every
+    action is testable via Runner(dry_run=True)."""
+
+    def __init__(self, cfg: PodConfig, runner: Runner | None = None):
+        self.cfg = cfg
+        self.runner = runner or Runner()
+
+    # -- argv builders (pure) -------------------------------------------
+
+    def _base(self, *verb: str) -> list[str]:
+        argv = ["gcloud", "compute", "tpus", "tpu-vm", *verb, self.cfg.name,
+                "--zone", self.cfg.zone]
+        if self.cfg.project:
+            argv += ["--project", self.cfg.project]
+        return argv
+
+    def _ssh(self, command: str, worker: str = "all") -> list[str]:
+        exports = "".join(f"export {k}={shlex.quote(v)}; "
+                          for k, v in self.cfg.env.items())
+        return self._base("ssh") + ["--worker", worker,
+                                    "--command", exports + command]
+
+    # -- lifecycle ------------------------------------------------------
+
+    def create(self) -> None:
+        """≙ launch (tf_ec2.py:796): create the slice, run setup."""
+        argv = self._base("create") + [
+            "--accelerator-type", self.cfg.accelerator_type,
+            "--version", self.cfg.runtime_version]
+        if self.cfg.spot:
+            argv.append("--spot")
+        self.runner.run(argv)
+        if self.cfg.setup_command:
+            self.runner.run(self._ssh(self.cfg.setup_command))
+
+    def delete(self) -> None:
+        """≙ shutdown (tf_ec2.py:440)."""
+        self.runner.run(self._base("delete") + ["--quiet"])
+
+    def status(self) -> dict[str, Any] | None:
+        """≙ list_running/list_idle (tf_ec2.py:371-404): slice state
+        plus whether python is running on any worker."""
+        out = self.runner.run(self._base("describe") + ["--format", "json"],
+                              capture=True)
+        if out is None:  # dry-run
+            return None
+        desc = json.loads(out.stdout)
+        probe = self.runner.run(self._ssh("pgrep -c python || true"),
+                                capture=True, check=False)
+        if probe is None or probe.returncode != 0:
+            idle = None  # probe failed — unknown, NOT "idle" (a caller
+            # keying deletion off idle must not kill a live run)
+        else:
+            idle = not any(line.strip() not in ("", "0")
+                           for line in (probe.stdout or "").splitlines())
+        return {"state": desc.get("state"), "idle": idle, "describe": desc}
+
+    # -- work -----------------------------------------------------------
+
+    def run_train(self) -> None:
+        """≙ run_tf (tf_ec2.py:445): same command on every worker —
+        jax.distributed discovers the slice topology; no role/host
+        templating exists."""
+        self.runner.run(self._ssh(
+            f"mkdir -p {shlex.quote(self.cfg.remote_outdir)} && "
+            f"cd ~ && nohup {self.cfg.train_command} "
+            f"> {self.cfg.remote_outdir}/train_stdout.log 2>&1 &"))
+
+    def kill_all(self, worker: str = "all") -> None:
+        """≙ kill_all_python / kill_python (tf_ec2.py:617-649)."""
+        self.runner.run(self._ssh("pkill -9 -f python || true", worker=worker),
+                        check=False)
+
+    def exec(self, command: str, worker: str = "all") -> None:
+        """≙ run_command (tf_ec2.py:841)."""
+        self.runner.run(self._ssh(command, worker=worker))
+
+    def download(self, local_dir: str | Path, remote_path: str | None = None,
+                 worker: str = "0") -> None:
+        """≙ download_outdir / download_file (tf_ec2.py:651-742)."""
+        remote = remote_path or self.cfg.remote_outdir
+        local_dir = Path(local_dir)
+        local_dir.mkdir(parents=True, exist_ok=True)
+        # scp's positional is <name>:<path>, not a bare name, so the
+        # _base helper doesn't apply
+        argv = ["gcloud", "compute", "tpus", "tpu-vm", "scp",
+                "--zone", self.cfg.zone]
+        if self.cfg.project:
+            argv += ["--project", self.cfg.project]
+        argv += ["--worker", worker, "--recurse",
+                 f"{self.cfg.name}:{remote}", str(local_dir)]
+        self.runner.run(argv)
+
+    def clean_launch_and_run(self) -> None:
+        """≙ clean_launch_and_run (tf_ec2.py:806): delete-if-exists →
+        create → run."""
+        self.runner.run(self._base("delete") + ["--quiet"], check=False)
+        self.create()
+        self.run_train()
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="distributedmnist_tpu.launch pod")
+    p.add_argument("action",
+                   choices=["create", "delete", "status", "run", "kill-all",
+                            "exec", "download", "clean-launch-run"])
+    p.add_argument("--config", default=None, help="PodConfig JSON")
+    p.add_argument("--dry-run", action="store_true",
+                   help="print gcloud commands instead of executing")
+    p.add_argument("--command", default=None, help="for exec")
+    p.add_argument("--worker", default=None, help="worker index or 'all'")
+    p.add_argument("--local-dir", default="./pod_results", help="for download")
+    p.add_argument("--remote-path", default=None, help="for download")
+    args = p.parse_args(argv)
+
+    cfg = PodConfig.from_file(args.config) if args.config else PodConfig()
+    mgr = PodManager(cfg, Runner(dry_run=args.dry_run))
+    if args.action == "create":
+        mgr.create()
+    elif args.action == "delete":
+        mgr.delete()
+    elif args.action == "status":
+        print(json.dumps(mgr.status(), indent=2))
+    elif args.action == "run":
+        mgr.run_train()
+    elif args.action == "kill-all":
+        mgr.kill_all(worker=args.worker or "all")
+    elif args.action == "exec":
+        if not args.command:
+            p.error("exec requires --command")
+        mgr.exec(args.command, worker=args.worker or "all")
+    elif args.action == "download":
+        mgr.download(args.local_dir, args.remote_path,
+                     worker=args.worker or "0")
+    elif args.action == "clean-launch-run":
+        mgr.clean_launch_and_run()
+    if args.dry_run:
+        print(json.dumps([shlex.join(a) for a in mgr.runner.recorded],
+                         indent=2))

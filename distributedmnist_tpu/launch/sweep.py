@@ -65,6 +65,9 @@ def run_experiment(cfg: ExperimentConfig, results_dir: str | Path,
         "timing": summary["timing"],
     }
     (results_dir / "result.json").write_text(json.dumps(record, indent=2))
+    from ..obsv.report import generate_report
+    generate_report(results_dir / "train", None, results_dir / "figures",
+                    name=cfg.name)
     logger.info("experiment %s: test_acc=%.4f, %.1f ex/s, p99 barrier=%.3fms",
                 cfg.name, record["test_accuracy"],
                 record["examples_per_sec"] or -1,
@@ -121,31 +124,8 @@ def write_report(records: list[dict[str, Any]], results_dir: str | Path) -> Path
     report = results_dir / "report.md"
     report.write_text("\n".join(lines) + "\n")
     try:
-        _plot(records, results_dir)
+        from ..obsv.report import plot_sweep
+        plot_sweep(records, results_dir)
     except Exception as e:  # plotting is best-effort, never fails a sweep
         logger.warning("plotting skipped: %s", e)
     return report
-
-
-def _plot(records: list[dict[str, Any]], results_dir: Path) -> None:
-    """Step-time CDFs per experiment (≙ the per-worker CDF figure,
-    tools/benchmark.py:226-263)."""
-    import matplotlib
-    matplotlib.use("Agg")
-    import matplotlib.pyplot as plt
-    import numpy as np
-
-    fig, ax = plt.subplots(figsize=(7, 4.5))
-    for r in records:
-        per_replica = r["timing"]["per_replica"]
-        if not per_replica:
-            continue
-        means = sorted(s["mean"] for s in per_replica)
-        ys = np.arange(1, len(means) + 1) / len(means)
-        ax.step(means, ys, where="post", label=r["name"])
-    ax.set_xlabel("mean per-replica step time (ms)")
-    ax.set_ylabel("CDF over replicas")
-    ax.legend(fontsize=7)
-    fig.tight_layout()
-    fig.savefig(results_dir / "step_time_cdf.png", dpi=120)
-    plt.close(fig)

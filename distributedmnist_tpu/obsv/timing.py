@@ -64,6 +64,7 @@ class StepTimeCollector:
         self.num_replicas = num_replicas
         self.capacity = capacity
         self._raw: list[Any] = []
+        self._materialized = 0  # prefix of _raw already fetched to host
         self._host_steps: list[float] = []  # host-measured wall per step
 
     def add(self, per_replica_times: Any, host_step_seconds: float | None = None) -> None:
@@ -73,10 +74,18 @@ class StepTimeCollector:
             self._host_steps.append(host_step_seconds)
 
     def matrix(self) -> np.ndarray:
-        """[steps, n_replicas] materialized compute times."""
+        """[steps, n_replicas] materialized compute times.
+
+        Materialization is incremental: entries already fetched from
+        device stay numpy, so periodic report/dump calls only transfer
+        rows added since the last call (not O(steps) device fetches
+        each time)."""
         if not self._raw:
             return np.zeros((0, self.num_replicas))
-        return np.stack([np.asarray(t) for t in self._raw])
+        for i in range(self._materialized, len(self._raw)):
+            self._raw[i] = np.asarray(self._raw[i])
+        self._materialized = len(self._raw)
+        return np.stack(self._raw)
 
     def per_replica_stats(self) -> list[CdfStats]:
         """≙ per-worker ELAPSED TIMES stats (tools/benchmark.py:67-111)."""
@@ -103,4 +112,5 @@ class StepTimeCollector:
 
     def reset(self) -> None:
         self._raw.clear()
+        self._materialized = 0
         self._host_steps.clear()

@@ -122,9 +122,15 @@ class Trainer:
             self._sink.write(record)
 
     def _dump_series(self) -> None:
-        """≙ worker%d_time_acc.npy dumps (src/distributed_train.py:373-379)."""
+        """≙ worker%d_time_acc.npy dumps (src/distributed_train.py:373-379),
+        plus the [steps, n_replicas] compute-time matrix the CDF report
+        plots (≙ the RPC-gossiped ELAPSED TIMES tables,
+        src/timeout_manager.py:31-70)."""
         if self.is_writer and self._series:
             np.save(self.train_dir / "time_acc.npy", np.asarray(self._series))
+            m = self.collector.matrix()
+            if m.size:
+                np.save(self.train_dir / "step_times.npy", m)
 
     # ------------------------------------------------------------------
 
@@ -167,7 +173,7 @@ class Trainer:
                 acc = float(m["train_acc"])
                 self._series.append((t, s, loss, acc))
                 record = {
-                    "event": "step", "step": s, "loss": loss,
+                    "event": "step", "step": s, "time": t, "loss": loss,
                     "train_acc": acc, "lr": float(m["lr"]),
                     "updates_applied": int(m["updates_applied"]),
                     "num_contributors": float(m["num_contributors"]),

@@ -1,0 +1,100 @@
+"""Pod launcher: argv construction parity with the reference's
+orchestrator subcommands (tools/tf_ec2.py:828-856), exercised through
+the dry-run seam — no gcloud needed."""
+
+import json
+
+import pytest
+
+from distributedmnist_tpu.launch.pod import (PodConfig, PodError, PodManager,
+                                             Runner)
+
+
+def _mgr(**cfg_kw):
+    cfg = PodConfig(name="t", zone="z", project="p", **cfg_kw)
+    return PodManager(cfg, Runner(dry_run=True))
+
+
+def test_create_builds_gcloud_argv():
+    m = _mgr(accelerator_type="v4-32", spot=True, setup_command="pip list")
+    m.create()
+    create, setup = m.runner.recorded
+    assert create[:6] == ["gcloud", "compute", "tpus", "tpu-vm", "create", "t"]
+    assert ["--zone", "z"] == create[6:8] and ["--project", "p"] == create[8:10]
+    assert ["--accelerator-type", "v4-32"] == create[10:12]
+    assert create[-1] == "--spot"
+    assert setup[4] == "ssh" and setup[-1].endswith("pip list")
+    assert ["--worker", "all"] in [setup[i:i + 2] for i in range(len(setup))]
+
+
+def test_env_exports_precede_command():
+    m = _mgr(env={"JAX_PLATFORMS": "tpu", "FLAG": "a b"})
+    m.exec("echo hi")
+    cmd = m.runner.recorded[0][-1]
+    assert cmd.startswith("export JAX_PLATFORMS=tpu; export FLAG='a b'; ")
+    assert cmd.endswith("echo hi")
+
+
+def test_run_train_is_detached_with_logs():
+    m = _mgr(train_command="python train.py", remote_outdir="/tmp/out")
+    m.run_train()
+    cmd = m.runner.recorded[0][-1]
+    assert "mkdir -p /tmp/out" in cmd
+    assert "nohup python train.py" in cmd
+    assert "/tmp/out/train_stdout.log" in cmd and cmd.rstrip().endswith("&")
+
+
+def test_kill_targets_single_worker():
+    m = _mgr()
+    m.kill_all(worker="3")
+    argv = m.runner.recorded[0]
+    i = argv.index("--worker")
+    assert argv[i + 1] == "3"
+    assert "pkill" in argv[-1]
+
+
+def test_download_scp_shape():
+    m = _mgr(remote_outdir="/tmp/out")
+    m.download("/tmp/local", worker="0")
+    argv = m.runner.recorded[0]
+    assert argv[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "scp"]
+    assert "--recurse" in argv
+    assert argv[-2] == "t:/tmp/out" and argv[-1] == "/tmp/local"
+
+
+def test_clean_launch_and_run_sequence():
+    m = _mgr()
+    m.clean_launch_and_run()
+    verbs = [a[4] for a in m.runner.recorded]
+    assert verbs == ["delete", "create", "ssh"]
+
+
+def test_config_file_roundtrip_and_unknown_key(tmp_path):
+    p = tmp_path / "pod.json"
+    p.write_text(json.dumps({"name": "x", "zone": "eu", "spot": True}))
+    cfg = PodConfig.from_file(p)
+    assert (cfg.name, cfg.zone, cfg.spot) == ("x", "eu", True)
+    p.write_text(json.dumps({"nmae": "typo"}))
+    with pytest.raises(PodError, match="nmae"):
+        PodConfig.from_file(p)
+
+
+def test_missing_binary_is_a_clear_error():
+    # a name that cannot exist on PATH — never invokes a real gcloud
+    with pytest.raises(PodError, match="gcloud"):
+        Runner(dry_run=False).run(["dmt-no-such-binary-for-test"])
+
+
+def test_cli_dry_run_prints_commands(capsys):
+    from distributedmnist_tpu.launch.pod import main
+    main(["create", "--dry-run"])
+    out = capsys.readouterr().out
+    cmds = json.loads(out)
+    assert any(c.startswith("gcloud compute tpus tpu-vm create") for c in cmds)
+
+
+def test_launch_cli_delegates_pod(capsys):
+    from distributedmnist_tpu.launch.__main__ import main
+    main(["pod", "delete", "--dry-run"])
+    out = capsys.readouterr().out
+    assert "delete" in out and "gcloud" in out

@@ -1,0 +1,97 @@
+"""Report harness: stats + figures from real trainer/evaluator logs
+(≙ the analysis half of tools/benchmark.py, minus the regex scraping —
+logs are structured from the start)."""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from conftest import base_config
+from distributedmnist_tpu.obsv import report as rpt
+
+
+@pytest.fixture(scope="module")
+def run_dirs(tmp_path_factory):
+    """One tiny real training run + one evaluator pass."""
+    root = tmp_path_factory.mktemp("report_run")
+    train_dir = root / "train"
+    eval_dir = root / "eval"
+    from distributedmnist_tpu.core.config import EvalConfig
+    from distributedmnist_tpu.evalsvc import Evaluator
+    from distributedmnist_tpu.train.loop import Trainer
+
+    cfg = base_config(
+        train={"max_steps": 8, "log_every_steps": 2,
+               "save_interval_secs": 0, "save_interval_steps": 8,
+               "save_results_period": 8, "train_dir": str(train_dir)})
+    Trainer(cfg).run()
+    Evaluator(train_dir,
+              EvalConfig(eval_dir=str(eval_dir), run_once=True,
+                         eval_interval_secs=0.01)).run()
+    return train_dir, eval_dir
+
+
+def test_load_experiment(run_dirs):
+    train_dir, eval_dir = run_dirs
+    data = rpt.load_experiment(train_dir, eval_dir)
+    assert [s["step"] for s in data["steps"]] == list(range(1, 9))
+    assert all("time" in s for s in data["steps"])
+    assert len(data["evals"]) == 1 and "time" in data["evals"][0]
+    assert data["step_times"] is not None and data["step_times"].shape == (8, 8)
+    assert data["time_acc"] is not None and data["time_acc"].shape[1] == 4
+
+
+def test_stats_and_figures(run_dirs, tmp_path):
+    train_dir, eval_dir = run_dirs
+    stats = rpt.generate_report(train_dir, eval_dir, tmp_path, name="t")
+    assert stats["num_steps"] == 8
+    assert "barrier" in stats and stats["barrier"]["count"] == 8
+    assert len(stats["per_replica"]) == 8
+    assert "p99" in stats["per_iteration"]
+    assert 0.0 <= stats["final_precision_at_1"] <= 1.0
+    saved = json.loads((tmp_path / "stats.json").read_text())
+    assert saved["num_steps"] == 8
+    for fig in ("step_loss.png", "time_loss.png", "time_step.png",
+                "time_precision.png", "replica_time_cdf.png"):
+        assert (tmp_path / fig).stat().st_size > 0, fig
+
+
+def test_load_jsonl_tolerates_torn_tail(tmp_path):
+    p = tmp_path / "log.jsonl"
+    p.write_text('{"event": "step", "step": 1}\n{"event": "st')
+    assert rpt.load_jsonl(p, "step") == [{"event": "step", "step": 1}]
+
+
+def test_old_logs_without_time_still_get_step_figures(tmp_path):
+    # regression: pre-"time"-field logs must not zero out the report
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    (train_dir / "train_log.jsonl").write_text(
+        '{"event": "step", "step": 1, "loss": 1.0, "train_acc": 0.1}\n'
+        '{"event": "step", "step": 2, "loss": 0.5, "train_acc": 0.2}\n')
+    data = rpt.load_experiment(train_dir)
+    written = {p.name for p in rpt.plot_experiment(data, tmp_path / "out")}
+    assert written == {"step_loss.png"}  # time-axis figures degrade away
+
+
+def test_plot_sweep_quorum_axis(tmp_path):
+    records = [
+        {"name": f"k{k}", "aggregate_k": k, "interval_ms": 0,
+         "test_accuracy": 0.9 + 0.01 * k, "examples_per_sec": 100.0 * k,
+         "timing": {"per_replica": [{"mean": float(k + i)}
+                                    for i in range(4)]}}
+        for k in (1, 2, 4)
+    ]
+    written = rpt.plot_sweep(records, tmp_path)
+    names = {p.name for p in written}
+    assert names == {"acc_vs_aggregate_k.png", "throughput_vs_aggregate_k.png",
+                     "step_time_cdf.png"}
+
+
+def test_plot_sweep_no_numeric_axis(tmp_path):
+    records = [{"name": "a", "aggregate_k": 4, "interval_ms": 0,
+                "test_accuracy": 0.9, "examples_per_sec": 10.0,
+                "timing": {"per_replica": []}}]
+    assert rpt.plot_sweep(records, tmp_path) == []
