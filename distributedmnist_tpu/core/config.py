@@ -70,6 +70,11 @@ class ModelConfig:
     # "flash": fused pallas kernel (ops/pallas_attention; interpreted
     # off-TPU), "dense": XLA einsum attention.
     attention_impl: str = "flash"
+    # Sequence-parallel strategy when mesh.seq_parallelism > 1:
+    # "ring" (ppermute K/V rotation, any head count) or "ulysses"
+    # (all-to-all head scatter; needs num_heads % seq_parallelism == 0,
+    # composes with the flash kernel).
+    sp_attention: str = "ring"
 
 
 @dataclass(frozen=True)
@@ -135,6 +140,9 @@ class MeshConfig:
     # Reserved axes so TP/SP can be added without redesign (SURVEY §5.7).
     model_parallelism: int = 1
     seq_parallelism: int = 1
+    # >0: force an N-virtual-CPU-device platform before backend init —
+    # the mock distributed backend (SURVEY §4) reachable from the CLI.
+    simulate_devices: int = 0
     replica_axis: str = "replica"
     model_axis: str = "model"
     seq_axis: str = "seq"

@@ -111,20 +111,27 @@ class Topology:
         """Sharding for a global batch: leading dim split over replicas."""
         return NamedSharding(self.mesh, P(self.replica_axis))
 
-    def device_put_batch(self, batch):
-        """Place a batch sharded over replicas.
+    def device_put_batch(self, batch, seq_sharded: bool = False):
+        """Place a batch sharded over replicas (rows) and, when
+        ``seq_sharded``, over the seq axis (second dim — the DP×SP
+        token layout).
 
         Single-process: a plain device_put of the global batch.
         Multi-host: each process holds only its local rows
         (global_batch / process_count — see data.pipeline), so the
         global array must be assembled from process-local shards.
+        (Sequence sharding should stay within a host for ingest: each
+        process holds full rows, and the placement splits the token dim
+        across its local devices.)
         """
+        sharding = (NamedSharding(self.mesh, P(self.replica_axis, self.seq_axis))
+                    if seq_sharded else self.batch_sharded)
         if jax.process_count() > 1:
             return jax.tree.map(
                 lambda x: jax.make_array_from_process_local_data(
-                    self.batch_sharded, np.asarray(x)),
+                    sharding, np.asarray(x)),
                 batch)
-        return jax.device_put(batch, self.batch_sharded)
+        return jax.device_put(batch, sharding)
 
     def device_put_replicated(self, tree):
         return jax.device_put(tree, self.replicated)

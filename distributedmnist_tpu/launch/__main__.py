@@ -22,15 +22,27 @@ import json
 import sys
 
 
-def _train(args) -> None:
+def _load_cfg_and_bringup(args):
+    """Parse config BEFORE touching any jax API, then bring the
+    platform up: a simulated N-device CPU mesh when the config asks for
+    one, multi-host discovery otherwise."""
     from ..core.config import ExperimentConfig, parse_cli_overrides
-    from ..core.mesh import initialize_distributed
-    initialize_distributed()  # multi-host bring-up before backend init
-    from ..train.loop import Trainer
+    from ..core.mesh import initialize_distributed, simulate_devices
 
     cfg = (ExperimentConfig.from_file(args.config) if args.config
            else ExperimentConfig())
-    cfg = cfg.override(parse_cli_overrides(args.overrides))
+    cfg = cfg.override(parse_cli_overrides(getattr(args, "overrides", [])))
+    if cfg.mesh.simulate_devices > 0:
+        simulate_devices(cfg.mesh.simulate_devices)
+    else:
+        initialize_distributed()  # multi-host bring-up before backend init
+    return cfg
+
+
+def _train(args) -> None:
+    cfg = _load_cfg_and_bringup(args)
+    from ..train.loop import Trainer
+
     trainer = Trainer(cfg)
     summary = trainer.run()
     result = trainer.evaluate("test")
@@ -117,15 +129,14 @@ def main(argv=None) -> None:
     pd = sub.add_parser("devices", help="show mesh topology")
     pd.set_defaults(fn=_devices)
 
+    def _pod(args) -> None:
+        from .pod import main as pod_main
+        pod_main(args.rest)
+
     pp = sub.add_parser("pod", help="TPU pod-slice lifecycle (gcloud)",
                         add_help=False)
-    pp.set_defaults(fn=None)
-
-    if argv is None:
-        argv = sys.argv[1:]
-    if argv and argv[0] == "pod":  # delegate the full sub-argv
-        from .pod import main as pod_main
-        return pod_main(argv[1:])
+    pp.add_argument("rest", nargs=argparse.REMAINDER)
+    pp.set_defaults(fn=_pod)
 
     args = p.parse_args(argv)
     args.fn(args)

@@ -146,11 +146,11 @@ class PodManager:
         plus whether python is running on any worker."""
         out = self.runner.run(self._base("describe") + ["--format", "json"],
                               capture=True)
-        if out is None:  # dry-run
-            return None
-        desc = json.loads(out.stdout)
         probe = self.runner.run(self._ssh("pgrep -c python || true"),
                                 capture=True, check=False)
+        if out is None:  # dry-run: both argvs recorded above, no result
+            return None
+        desc = json.loads(out.stdout)
         if probe is None or probe.returncode != 0:
             idle = None  # probe failed — unknown, NOT "idle" (a caller
             # keying deletion off idle must not kill a live run)
@@ -165,10 +165,11 @@ class PodManager:
         """≙ run_tf (tf_ec2.py:445): same command on every worker —
         jax.distributed discovers the slice topology; no role/host
         templating exists."""
+        outdir = shlex.quote(self.cfg.remote_outdir)
+        log = shlex.quote(f"{self.cfg.remote_outdir}/train_stdout.log")
         self.runner.run(self._ssh(
-            f"mkdir -p {shlex.quote(self.cfg.remote_outdir)} && "
-            f"cd ~ && nohup {self.cfg.train_command} "
-            f"> {self.cfg.remote_outdir}/train_stdout.log 2>&1 &"))
+            f"mkdir -p {outdir} && cd ~ && "
+            f"nohup {self.cfg.train_command} > {log} 2>&1 &"))
 
     def kill_all(self, worker: str = "all") -> None:
         """≙ kill_all_python / kill_python (tf_ec2.py:617-649)."""

@@ -65,9 +65,12 @@ def run_experiment(cfg: ExperimentConfig, results_dir: str | Path,
         "timing": summary["timing"],
     }
     (results_dir / "result.json").write_text(json.dumps(record, indent=2))
-    from ..obsv.report import generate_report
-    generate_report(results_dir / "train", None, results_dir / "figures",
-                    name=cfg.name)
+    try:
+        from ..obsv.report import generate_report
+        generate_report(results_dir / "train", None, results_dir / "figures",
+                        name=cfg.name)
+    except Exception as e:  # reporting is best-effort, never fails a sweep
+        logger.warning("per-experiment report skipped: %s", e)
     logger.info("experiment %s: test_acc=%.4f, %.1f ex/s, p99 barrier=%.3fms",
                 cfg.name, record["test_accuracy"],
                 record["examples_per_sec"] or -1,

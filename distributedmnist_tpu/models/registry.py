@@ -66,6 +66,11 @@ class Model:
     input_shape: tuple[int, ...]
     input_dtype: Any = jnp.float32
     eval_metrics: Callable[..., tuple] = classification_eval_metrics
+    # Sequence-parallel support (long-context models only):
+    # factory(seq_axis_name) -> apply_sp(params, tokens_local,
+    # positions_local) -> logits_local, run inside shard_map with the
+    # sequence dim sharded over seq_axis_name.
+    sp_apply_factory: Callable[[str], Callable[..., jax.Array]] | None = None
 
 
 _REGISTRY: dict[str, Callable[[ModelConfig], Model]] = {}
@@ -152,7 +157,37 @@ def _transformer(cfg: ModelConfig) -> Model:
                                  attention_fn=attention_fn,
                                  compute_dtype=compute_dtype)
 
+    def sp_apply_factory(seq_axis: str):
+        """Sequence-sharded apply for the DP×SP train step: tokens
+        arrive as [b, seq_local] slices; attention crosses shards via
+        the configured strategy."""
+        if cfg.sp_attention == "ring":
+            from ..ops.ring_attention import ring_self_attention
+
+            def sp_attn(q, k, v, causal=True, scale=None):
+                return ring_self_attention(q, k, v, seq_axis, causal=causal,
+                                           scale=scale)
+        elif cfg.sp_attention == "ulysses":
+            from ..ops.ulysses_attention import ulysses_self_attention
+            inner = attention_fn  # flash or dense, per attention_impl
+
+            def sp_attn(q, k, v, causal=True, scale=None):
+                return ulysses_self_attention(q, k, v, seq_axis,
+                                              causal=causal, scale=scale,
+                                              attention_fn=inner)
+        else:
+            raise ValueError(f"unknown sp_attention {cfg.sp_attention!r}")
+
+        def apply_sp(params, tokens, positions):
+            return transformer.apply(params, tokens, num_heads=cfg.num_heads,
+                                     attention_fn=sp_attn,
+                                     positions=positions,
+                                     compute_dtype=compute_dtype)
+
+        return apply_sp
+
     return Model(name=cfg.name, init=init, apply=apply,
                  loss=transformer.loss_fn, accuracy=transformer.accuracy,
                  input_shape=(cfg.seq_len,), input_dtype=jnp.int32,
-                 eval_metrics=lm_eval_metrics)
+                 eval_metrics=lm_eval_metrics,
+                 sp_apply_factory=sp_apply_factory)

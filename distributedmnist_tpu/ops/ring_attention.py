@@ -67,8 +67,12 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     def vary(x):
         # initial accumulators must carry the same varying-axis type as
-        # the loop outputs (which depend on the sharded q/k/v)
-        return lax.pcast(x, axis_name, to="varying")
+        # the loop outputs — i.e. q's full vma, which under DP×SP
+        # includes the replica axis too, not just the ring axis
+        want = getattr(jax.typeof(q), "vma", frozenset()) or frozenset()
+        have = getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+        missing = tuple(want - have)
+        return lax.pcast(x, missing, to="varying") if missing else x
 
     m0 = vary(jnp.full((b, h, s_loc), _NEG_INF, jnp.float32))
     l0 = vary(jnp.zeros((b, h, s_loc), jnp.float32))

@@ -123,10 +123,56 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
     k = policies.resolve_aggregate_k(sync, n)
     momentum = cfg.optim.momentum
 
+    # Sequence parallelism: when the mesh spends devices on the seq
+    # axis, the model must provide a sequence-sharded apply (the
+    # transformer does, via ring/all-to-all attention). Each shard then
+    # computes a PARTIAL loss/gradient over its token slice; psum over
+    # the seq axis reassembles the exact full-sequence gradient before
+    # the replica-axis aggregation disciplines see it.
+    seq_ax = topo.seq_axis
+    n_seq = topo.mesh.shape[seq_ax]
+    if n_seq > 1 and getattr(model, "sp_apply_factory", None) is None:
+        raise ValueError(
+            f"mesh has seq_parallelism={n_seq} but model {model.name!r} has "
+            "no sequence-sharded apply (sp_apply_factory)")
+    sp_apply = model.sp_apply_factory(seq_ax) if n_seq > 1 else None
+    grad_axes = (axis, seq_ax) if sp_apply else (axis,)
+
     def local_loss(params, batch, dropout_key):
         logits = model.apply(params, batch["image"], train=True,
                              dropout_key=dropout_key)
         return model.loss(logits, batch["label"]), logits
+
+    def local_loss_sp(params, batch, dropout_key):
+        """Per-(replica, seq-shard) partial next-token loss.
+
+        Targets are inputs shifted left by one GLOBAL position, so the
+        target of a shard's last token lives on the next shard — one
+        ppermute fetches each neighbor's first column. The global last
+        position has no target (weight 0), matching the dense
+        ``transformer.loss_fn`` exactly: partial sums are normalized by
+        the global valid-token count so psum(partials) == dense loss.
+        """
+        del dropout_key
+        tokens = batch["image"]
+        labels = batch["label"]
+        b, s_loc = tokens.shape
+        me_s = lax.axis_index(seq_ax)
+        positions = me_s * s_loc + jnp.arange(s_loc)
+        logits = sp_apply(params, tokens, positions)  # [b, s_loc, V]
+
+        # shard j receives shard (j+1)'s first target column
+        perm = [((j + 1) % n_seq, j) for j in range(n_seq)]
+        nxt = lax.ppermute(labels[:, :1], seq_ax, perm)
+        tgt = jnp.concatenate([labels[:, 1:], nxt], axis=1).astype(jnp.int32)
+
+        s_global = s_loc * n_seq
+        w = (positions < s_global - 1).astype(jnp.float32)[None, :]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        correct = (jnp.argmax(logp, axis=-1) == tgt).astype(jnp.float32)
+        total = b * (s_global - 1)  # this replica's global token count
+        return jnp.sum(nll * w) / total, jnp.sum(correct * w) / total
 
     def shard_fn(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
         me = lax.axis_index(axis)
@@ -138,15 +184,24 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         #
         # Params are replicated over the mesh; differentiating w.r.t. a
         # *replicated* value inside shard_map makes AD insert the
-        # cross-replica psum itself (transpose of the broadcast). We
-        # need the raw per-replica gradient — masks must apply BEFORE
-        # aggregation — so cast params to replica-varying first.
+        # cross-axis psum itself (transpose of the broadcast). We need
+        # the raw per-shard gradient — masks must apply BEFORE the
+        # replica aggregation, and the seq-axis psum must be explicit —
+        # so cast params to varying over every grad axis first.
         dkey = prng.replica_key(state.root_key, "dropout", step, me)
         local_params = jax.tree.map(
-            lambda x: lax.pcast(x, axis, to="varying"), state.params)
-        (loss, logits), grads = jax.value_and_grad(local_loss, has_aux=True)(
-            local_params, batch, dkey)
-        train_acc = model.accuracy(logits, batch["label"])
+            lambda x: lax.pcast(x, grad_axes, to="varying"), state.params)
+        if sp_apply is not None:
+            (loss_p, acc_p), grads = jax.value_and_grad(
+                local_loss_sp, has_aux=True)(local_params, batch, dkey)
+            # reassemble the full-sequence gradient / metrics
+            loss = lax.psum(loss_p, seq_ax)
+            train_acc = lax.psum(acc_p, seq_ax)
+            grads = jax.tree.map(lambda g: lax.psum(g, seq_ax), grads)
+        else:
+            (loss, logits), grads = jax.value_and_grad(
+                local_loss, has_aux=True)(local_params, batch, dkey)
+            train_acc = model.accuracy(logits, batch["label"])
 
         # --- per-worker drop-connect before aggregation
         # (src/distributed_train.py:194-196) --------------------------
@@ -256,9 +311,10 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         "updates_applied": P(), "step_times_ms": P(), "flags": P(),
         "applied": P(),
     }
+    batch_spec = P(axis, seq_ax) if sp_apply else P(axis)
     sharded = jax.shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(P(), P(axis)),
+        in_specs=(P(), batch_spec),
         out_specs=(P(), metrics_specs))
 
     return jax.jit(sharded, donate_argnums=0)

@@ -51,6 +51,12 @@ class Trainer:
         if cfg.data.batch_size % n != 0:
             raise ValueError(f"global batch {cfg.data.batch_size} not divisible "
                              f"by {n} replicas")
+        # DP×SP: tokens sharded over the seq axis too (transformer only)
+        n_seq = self.topo.mesh.shape[self.topo.seq_axis]
+        self.seq_sharded = n_seq > 1
+        if self.seq_sharded and cfg.model.seq_len % n_seq != 0:
+            raise ValueError(f"seq_len {cfg.model.seq_len} not divisible by "
+                             f"seq_parallelism {n_seq}")
         from ..parallel.policies import resolve_aggregate_k
         k = resolve_aggregate_k(cfg.sync, n)
         # LR schedule keyed to applied updates; decay_steps ÷ k
@@ -200,7 +206,8 @@ class Trainer:
                 profiling = True
             t0 = time.time()
             batch = next(self.train_iter)
-            gbatch = self.topo.device_put_batch(batch)
+            gbatch = self.topo.device_put_batch(batch,
+                                                seq_sharded=self.seq_sharded)
             if inject_measured:
                 self.state = self.state.replace(
                     measured_ms=jnp.float32(host_dt * 1000.0))
