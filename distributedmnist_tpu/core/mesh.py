@@ -136,6 +136,17 @@ class Topology:
     def device_put_replicated(self, tree):
         return jax.device_put(tree, self.replicated)
 
+    def device_put_state(self, tree, specs):
+        """Place a state pytree per a PartitionSpec tree. ``specs`` may
+        be a *prefix* of ``tree`` (a single spec covering a subtree —
+        e.g. P() for all params when not tensor-parallel)."""
+        is_spec = lambda x: isinstance(x, PartitionSpec)  # noqa: E731
+        spec_leaves, treedef = jax.tree.flatten(specs, is_leaf=is_spec)
+        subtrees = treedef.flatten_up_to(tree)
+        placed = [jax.device_put(sub, NamedSharding(self.mesh, spec))
+                  for sub, spec in zip(subtrees, spec_leaves)]
+        return jax.tree.unflatten(treedef, placed)
+
 
 def make_topology(cfg: MeshConfig | None = None,
                   devices: Sequence[jax.Device] | None = None) -> Topology:
@@ -147,6 +158,14 @@ def make_topology(cfg: MeshConfig | None = None,
     redesign (SURVEY §5.7, §7).
     """
     cfg = cfg or MeshConfig()
+    if (devices is None and cfg.simulate_devices > 0
+            and len(jax.devices()) < cfg.simulate_devices):
+        # A config that trained on a simulated mesh must be loadable by
+        # every consumer (evaluator, sweep, report), not just the train
+        # CLI — tear down the 1-device backend and force the CPU mesh.
+        import jax.extend.backend as jeb
+        jeb.clear_backends()
+        simulate_devices(cfg.simulate_devices)
     devs = list(devices if devices is not None else jax.devices())
     mp, sp = max(1, cfg.model_parallelism), max(1, cfg.seq_parallelism)
     n = cfg.num_replicas

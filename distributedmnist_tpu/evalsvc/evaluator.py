@@ -25,7 +25,8 @@ from ..core.log import JsonlSink, eval_line, get_logger
 from ..core.mesh import Topology, make_topology
 from ..data.datasets import Datasets, load_datasets
 from ..models.registry import get_model
-from ..parallel.api import build_eval_step, init_train_state
+from ..parallel.api import (build_eval_step, init_train_state,
+                            state_partition_specs)
 from ..train import checkpoint as ckpt
 from ..train.evaluation import run_full_eval
 
@@ -95,7 +96,8 @@ class Evaluator:
         if restored is None:
             return None
         state, _, at_step = restored
-        params = self.topo.device_put_replicated(state.params)
+        specs = state_partition_specs(self.model, self.cfg, self.topo)
+        params = self.topo.device_put_state(state.params, specs.params)
         out = run_full_eval(self.eval_fn, params, self.topo,
                             self.datasets.test, self.eval_cfg.eval_batch_size)
         result = {

@@ -66,11 +66,17 @@ class Model:
     input_shape: tuple[int, ...]
     input_dtype: Any = jnp.float32
     eval_metrics: Callable[..., tuple] = classification_eval_metrics
-    # Sequence-parallel support (long-context models only):
-    # factory(seq_axis_name) -> apply_sp(params, tokens_local,
-    # positions_local) -> logits_local, run inside shard_map with the
-    # sequence dim sharded over seq_axis_name.
-    sp_apply_factory: Callable[[str], Callable[..., jax.Array]] | None = None
+    # Sharded-execution support (long-context models only):
+    # factory(seq_axis, model_axis) -> apply(params, tokens_local,
+    # positions_local) -> logits_local, run inside shard_map. Either
+    # axis may be None (unsharded); with seq_axis the sequence dim is
+    # sharded (ring/all-to-all attention), with model_axis params are
+    # tensor-parallel per ``tp_param_specs``.
+    sharded_apply_factory: (Callable[[str | None, str | None],
+                                     Callable[..., jax.Array]] | None) = None
+    # factory(model_axis) -> params-shaped pytree of PartitionSpec for
+    # tensor-parallel parameter placement.
+    tp_param_specs: Callable[[str], Any] | None = None
 
 
 _REGISTRY: dict[str, Callable[[ModelConfig], Model]] = {}
@@ -157,37 +163,42 @@ def _transformer(cfg: ModelConfig) -> Model:
                                  attention_fn=attention_fn,
                                  compute_dtype=compute_dtype)
 
-    def sp_apply_factory(seq_axis: str):
-        """Sequence-sharded apply for the DP×SP train step: tokens
-        arrive as [b, seq_local] slices; attention crosses shards via
-        the configured strategy."""
-        if cfg.sp_attention == "ring":
+    def sharded_apply_factory(seq_axis: str | None, model_axis: str | None):
+        """Sharded apply for the DP×SP×TP train step: tokens arrive as
+        [b, seq_local] slices; attention crosses seq shards via the
+        configured strategy; params may be tensor-parallel shards."""
+        if seq_axis is None:
+            sharded_attn = attention_fn  # flash or dense, per attention_impl
+        elif cfg.sp_attention == "ring":
             from ..ops.ring_attention import ring_self_attention
 
-            def sp_attn(q, k, v, causal=True, scale=None):
+            def sharded_attn(q, k, v, causal=True, scale=None):
                 return ring_self_attention(q, k, v, seq_axis, causal=causal,
                                            scale=scale)
         elif cfg.sp_attention == "ulysses":
             from ..ops.ulysses_attention import ulysses_self_attention
-            inner = attention_fn  # flash or dense, per attention_impl
+            inner = attention_fn
 
-            def sp_attn(q, k, v, causal=True, scale=None):
+            def sharded_attn(q, k, v, causal=True, scale=None):
                 return ulysses_self_attention(q, k, v, seq_axis,
                                               causal=causal, scale=scale,
                                               attention_fn=inner)
         else:
             raise ValueError(f"unknown sp_attention {cfg.sp_attention!r}")
 
-        def apply_sp(params, tokens, positions):
+        def apply_sharded(params, tokens, positions):
             return transformer.apply(params, tokens, num_heads=cfg.num_heads,
-                                     attention_fn=sp_attn,
+                                     attention_fn=sharded_attn,
                                      positions=positions,
-                                     compute_dtype=compute_dtype)
+                                     compute_dtype=compute_dtype,
+                                     model_axis=model_axis)
 
-        return apply_sp
+        return apply_sharded
 
     return Model(name=cfg.name, init=init, apply=apply,
                  loss=transformer.loss_fn, accuracy=transformer.accuracy,
                  input_shape=(cfg.seq_len,), input_dtype=jnp.int32,
                  eval_metrics=lm_eval_metrics,
-                 sp_apply_factory=sp_apply_factory)
+                 sharded_apply_factory=sharded_apply_factory,
+                 tp_param_specs=lambda axis: transformer.param_partition_specs(
+                     cfg.num_layers, axis))

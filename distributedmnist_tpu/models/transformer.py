@@ -10,6 +10,11 @@ Pure init/apply over a param pytree, pre-norm blocks, learned
 positional embeddings, weight-tied LM head. ``attention_fn`` is
 injectable: ``local_self_attention`` single-device, or a closure over
 ``ring_self_attention(axis_name=...)`` under a seq-sharded shard_map.
+
+Tensor parallelism (Megatron-style) is built in: pass ``model_axis``
+when params are sharded per :func:`param_partition_specs` — qkv/w1
+column-parallel, wo/w2 row-parallel with one psum per residual add,
+attention heads split across the axis.
 """
 
 from __future__ import annotations
@@ -18,6 +23,8 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
 
 from .cnn import truncated_normal_init
 from ..ops.ring_attention import local_self_attention
@@ -40,13 +47,33 @@ def init(key: jax.Array, vocab_size: int = 256, model_dim: int = 128,
     for _ in range(num_layers):
         params["blocks"].append({
             "ln1": {"scale": jnp.ones((model_dim,), jnp.float32)},
-            "wqkv": truncated_normal_init(next(keys), (model_dim, 3 * model_dim), scale),
+            # [d, 3, d] (not [d, 3d]): the last dim is the shardable
+            # per-head output dim, so a model-axis column shard keeps
+            # whole q/k/v head groups together
+            "wqkv": truncated_normal_init(next(keys), (model_dim, 3, model_dim), scale),
             "wo": truncated_normal_init(next(keys), (model_dim, model_dim), scale),
             "ln2": {"scale": jnp.ones((model_dim,), jnp.float32)},
             "w1": truncated_normal_init(next(keys), (model_dim, 4 * model_dim), scale),
             "w2": truncated_normal_init(next(keys), (4 * model_dim, model_dim), scale),
         })
     return params
+
+
+def param_partition_specs(num_layers: int, model_axis: str) -> Params:
+    """Megatron TP layout: qkv & MLP-in column-parallel (output dim
+    sharded), their consumers wo & MLP-out row-parallel (input dim
+    sharded → one psum each per block); embeddings and norms replicated."""
+    P = PartitionSpec
+    blocks = [{
+        "ln1": {"scale": P()},
+        "wqkv": P(None, None, model_axis),
+        "wo": P(model_axis, None),
+        "ln2": {"scale": P()},
+        "w1": P(None, model_axis),
+        "w2": P(model_axis, None),
+    } for _ in range(num_layers)]
+    return {"embed": P(), "pos": P(), "blocks": blocks,
+            "final_norm": {"scale": P()}}
 
 
 def _rms_norm(x: jax.Array, p: Params) -> jax.Array:
@@ -57,11 +84,19 @@ def _rms_norm(x: jax.Array, p: Params) -> jax.Array:
 def apply(params: Params, tokens: jax.Array, *, num_heads: int = 4,
           attention_fn: Callable | None = None,
           positions: jax.Array | None = None,
-          compute_dtype=jnp.bfloat16) -> jax.Array:
+          compute_dtype=jnp.bfloat16,
+          model_axis: str | None = None) -> jax.Array:
     """tokens [batch, seq] int32 → logits [batch, seq, vocab] float32.
 
     ``positions`` (global positions of this shard's tokens) must be
     passed when the sequence is sharded; defaults to arange(seq).
+
+    ``model_axis``: when set (inside shard_map, params sharded per
+    :func:`param_partition_specs`), runs tensor-parallel — this rank
+    computes its ``num_heads / axis_size`` heads and its MLP column
+    slice; row-parallel projections psum partial sums back to the full
+    residual. Activations stay replicated over the axis, so the logits
+    (and any loss) are identical on every TP rank.
     """
     attn = attention_fn or local_self_attention
     b, s = tokens.shape
@@ -71,19 +106,30 @@ def apply(params: Params, tokens: jax.Array, *, num_heads: int = 4,
     x = p["embed"][tokens] + p["pos"][positions]
     d = x.shape[-1]
     hd = d // num_heads
+    m = lax.axis_size(model_axis) if model_axis else 1
+    if num_heads % m != 0:
+        raise ValueError(f"num_heads={num_heads} not divisible by "
+                         f"model-parallel size {m}")
+    h_local = num_heads // m
     for blk in p["blocks"]:
         h = _rms_norm(x, blk["ln1"])
-        qkv = h @ blk["wqkv"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        qkv = jnp.einsum("bsd,dte->bste", h, blk["wqkv"])  # e = d/m
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
         def heads(t):
-            return t.reshape(b, -1, num_heads, hd).transpose(0, 2, 1, 3)
+            return t.reshape(b, -1, h_local, hd).transpose(0, 2, 1, 3)
 
         o = attn(heads(q), heads(k), heads(v))
-        o = o.transpose(0, 2, 1, 3).reshape(b, -1, d)
-        x = x + o @ blk["wo"]
+        o = o.transpose(0, 2, 1, 3).reshape(b, -1, d // m)
+        proj = o @ blk["wo"]  # row-parallel: partial sum of the full d
+        if model_axis:
+            proj = lax.psum(proj, model_axis)
+        x = x + proj
         h = _rms_norm(x, blk["ln2"])
-        x = x + jax.nn.relu(h @ blk["w1"]) @ blk["w2"]
+        mlp = jax.nn.relu(h @ blk["w1"]) @ blk["w2"]
+        if model_axis:
+            mlp = lax.psum(mlp, model_axis)
+        x = x + mlp
     x = _rms_norm(x, p["final_norm"])
     logits = x @ p["embed"].T  # tied head
     return logits.astype(jnp.float32)

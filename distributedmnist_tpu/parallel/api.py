@@ -61,6 +61,30 @@ class TrainState(struct.PyTreeNode):
     next_apply_ms: jax.Array
 
 
+def state_partition_specs(model: Model, cfg: ExperimentConfig,
+                          topo: Topology) -> TrainState:
+    """A TrainState-shaped pytree of PartitionSpecs: P() (replicated)
+    everywhere, except param-shaped subtrees which take the model's
+    tensor-parallel specs when the mesh's model axis is >1."""
+    from jax.sharding import PartitionSpec as P_
+
+    n_model = topo.mesh.shape[topo.model_axis]
+    if n_model > 1 and getattr(model, "tp_param_specs", None) is None:
+        raise ValueError(f"mesh has model_parallelism={n_model} but model "
+                         f"{model.name!r} has no tensor-parallel parameter "
+                         "specs")
+    pspec: Any = (model.tp_param_specs(topo.model_axis) if n_model > 1
+                  else P_())
+    has_momentum = cfg.optim.momentum > 0.0
+    interval = cfg.sync.mode == "interval"
+    return TrainState(
+        params=pspec,
+        momentum=pspec if has_momentum else None,
+        step=P_(), updates_applied=P_(), root_key=P_(), measured_ms=P_(),
+        window_acc=pspec if interval else None,
+        window_rounds=P_(), wall_ms=P_(), next_apply_ms=P_())
+
+
 def init_train_state(model: Model, cfg: ExperimentConfig) -> TrainState:
     params = model.init(jax.random.PRNGKey(cfg.model.init_seed))
     momentum = (jax.tree.map(jnp.zeros_like, params)
@@ -129,14 +153,33 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
     # computes a PARTIAL loss/gradient over its token slice; psum over
     # the seq axis reassembles the exact full-sequence gradient before
     # the replica-axis aggregation disciplines see it.
+    #
+    # Tensor parallelism: when the mesh's model axis is >1, params are
+    # placed per the model's TP partition specs; each rank holds its
+    # head/MLP column shard, activations stay replicated over the axis
+    # (psums inside apply), and each rank's param gradients are its own
+    # shard's — no model-axis reduction of gradients is needed.
     seq_ax = topo.seq_axis
     n_seq = topo.mesh.shape[seq_ax]
-    if n_seq > 1 and getattr(model, "sp_apply_factory", None) is None:
+    model_ax = topo.model_axis
+    n_model = topo.mesh.shape[model_ax]
+    if ((n_seq > 1 or n_model > 1)
+            and getattr(model, "sharded_apply_factory", None) is None):
         raise ValueError(
-            f"mesh has seq_parallelism={n_seq} but model {model.name!r} has "
-            "no sequence-sharded apply (sp_apply_factory)")
-    sp_apply = model.sp_apply_factory(seq_ax) if n_seq > 1 else None
-    grad_axes = (axis, seq_ax) if sp_apply else (axis,)
+            f"mesh has seq_parallelism={n_seq} / model_parallelism="
+            f"{n_model} but model {model.name!r} supports neither "
+            "(no sharded_apply_factory)")
+    if n_model > 1 and getattr(model, "tp_param_specs", None) is None:
+        raise ValueError(f"model {model.name!r} has no tensor-parallel "
+                         "parameter specs")
+    sharded_apply = (model.sharded_apply_factory(
+        seq_ax if n_seq > 1 else None, model_ax if n_model > 1 else None)
+        if (n_seq > 1 or n_model > 1) else None)
+    # raw per-shard grads are needed w.r.t. the axes the masks/explicit
+    # psums manage; the model axis stays as-is (sharded params are
+    # already device-varying there)
+    grad_axes = (axis, seq_ax) if n_seq > 1 else (axis,)
+    state_specs = state_partition_specs(model, cfg, topo)
 
     def local_loss(params, batch, dropout_key):
         logits = model.apply(params, batch["image"], train=True,
@@ -159,7 +202,7 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         b, s_loc = tokens.shape
         me_s = lax.axis_index(seq_ax)
         positions = me_s * s_loc + jnp.arange(s_loc)
-        logits = sp_apply(params, tokens, positions)  # [b, s_loc, V]
+        logits = sharded_apply(params, tokens, positions)  # [b, s_loc, V]
 
         # shard j receives shard (j+1)'s first target column
         perm = [((j + 1) % n_seq, j) for j in range(n_seq)]
@@ -191,7 +234,7 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         dkey = prng.replica_key(state.root_key, "dropout", step, me)
         local_params = jax.tree.map(
             lambda x: lax.pcast(x, grad_axes, to="varying"), state.params)
-        if sp_apply is not None:
+        if sharded_apply is not None:
             (loss_p, acc_p), grads = jax.value_and_grad(
                 local_loss_sp, has_aux=True)(local_params, batch, dkey)
             # reassemble the full-sequence gradient / metrics
@@ -311,11 +354,11 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         "updates_applied": P(), "step_times_ms": P(), "flags": P(),
         "applied": P(),
     }
-    batch_spec = P(axis, seq_ax) if sp_apply else P(axis)
+    batch_spec = P(axis, seq_ax) if sharded_apply else P(axis)
     sharded = jax.shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(P(), batch_spec),
-        out_specs=(P(), metrics_specs))
+        in_specs=(state_specs, batch_spec),
+        out_specs=(state_specs, metrics_specs))
 
     return jax.jit(sharded, donate_argnums=0)
 
@@ -328,9 +371,29 @@ def build_eval_step(model: Model, cfg: ExperimentConfig, topo: Topology):
     (correct, weighted_loss, weight) — caller divides.
     """
     axis = topo.replica_axis
+    model_ax = topo.model_axis
+    n_model = topo.mesh.shape[model_ax]
+    if n_model > 1:
+        # tensor-parallel params: sharded apply (full sequence per
+        # device — eval batches are not seq-sharded), sharded in_spec
+        if (getattr(model, "tp_param_specs", None) is None
+                or getattr(model, "sharded_apply_factory", None) is None):
+            raise ValueError(f"mesh has model_parallelism={n_model} but "
+                             f"model {model.name!r} is not tensor-parallel "
+                             "capable")
+        pspec: Any = model.tp_param_specs(model_ax)
+        tp_apply = model.sharded_apply_factory(None, model_ax)
+
+        def run(params, images):
+            return tp_apply(params, images, None)
+    else:
+        pspec = P()
+
+        def run(params, images):
+            return model.apply(params, images, train=False)
 
     def shard_fn(params, batch):
-        logits = model.apply(params, batch["image"], train=False)
+        logits = run(params, batch["image"])
         correct, loss_sum, weight = model.eval_metrics(
             logits, batch["label"], batch["weight"])
         return (lax.psum(correct, axis), lax.psum(loss_sum, axis),
@@ -338,6 +401,6 @@ def build_eval_step(model: Model, cfg: ExperimentConfig, topo: Topology):
 
     sharded = jax.shard_map(
         shard_fn, mesh=topo.mesh,
-        in_specs=(P(), P(axis)),
+        in_specs=(pspec, P(axis)),
         out_specs=(P(), P(), P()))
     return jax.jit(sharded)

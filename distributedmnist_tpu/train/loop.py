@@ -28,7 +28,7 @@ from .evaluation import run_full_eval
 from ..models.registry import Model, get_model
 from ..obsv.timing import StepTimeCollector
 from ..parallel.api import (TrainState, build_eval_step, build_train_step,
-                            init_train_state)
+                            init_train_state, state_partition_specs)
 from . import checkpoint as ckpt
 from .lr_schedule import constant, decay_steps_for, exponential_decay
 
@@ -73,8 +73,9 @@ class Trainer:
 
         self.step_fn = build_train_step(self.model, cfg, self.topo, self.schedule)
         self.eval_fn = build_eval_step(self.model, cfg, self.topo)
+        self.state_specs = state_partition_specs(self.model, cfg, self.topo)
         self.state: TrainState = init_train_state(self.model, cfg)
-        self.state = self.topo.device_put_replicated(self.state)
+        self.state = self.topo.device_put_state(self.state, self.state_specs)
 
         self.train_iter = make_train_iterator(
             self.datasets.train, cfg.data, seed=cfg.train.seed,
@@ -98,7 +99,7 @@ class Trainer:
         if restored is None:
             return
         state, extra, step = restored
-        self.state = self.topo.device_put_replicated(state)
+        self.state = self.topo.device_put_state(state, self.state_specs)
         if "data_iter" in extra:
             try:
                 self.train_iter.restore(extra["data_iter"])

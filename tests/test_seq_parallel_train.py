@@ -114,7 +114,6 @@ def test_trainer_end_to_end_seq_parallel(tmp_train_dir):
     summary = tr.run()
     assert summary["final_step"] == 20
     assert summary["last_metrics"]["num_contributors"] == 1.0
-    first_loss = None
     # loss must drop from roughly ln(vocab) chance level
     assert summary["last_metrics"]["loss"] < 3.4
     ev = tr.evaluate("test")
