@@ -140,12 +140,16 @@ class MeshConfig:
     # Reserved axes so TP/SP can be added without redesign (SURVEY §5.7).
     model_parallelism: int = 1
     seq_parallelism: int = 1
+    # GPipe-style layer pipelining over the 'stage' axis.
+    pipeline_parallelism: int = 1
+    pipeline_microbatches: int = 4
     # >0: force an N-virtual-CPU-device platform before backend init —
     # the mock distributed backend (SURVEY §4) reachable from the CLI.
     simulate_devices: int = 0
     replica_axis: str = "replica"
     model_axis: str = "model"
     seq_axis: str = "seq"
+    stage_axis: str = "stage"
 
 
 @dataclass(frozen=True)
@@ -161,6 +165,9 @@ class TrainConfig:
     save_results_period: int = 1000  # ≙ FLAGS.save_results_period (:56-57)
     summary_every_steps: int = 100  # ≙ save_summaries_secs (:78)
     keep_checkpoints: int = 5
+    # Background-thread checkpoint writes (serialization + IO off the
+    # hot loop); the final save always drains before run() returns.
+    async_checkpoint: bool = True
     resume: bool = True  # ≙ Supervisor restore-if-present (:262)
     profile_steps: tuple[int, int] = (0, 0)  # (start, stop) jax.profiler window
 

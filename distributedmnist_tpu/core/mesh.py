@@ -93,6 +93,7 @@ class Topology:
     replica_axis: str
     model_axis: str
     seq_axis: str
+    stage_axis: str
 
     @property
     def num_replicas(self) -> int:
@@ -152,10 +153,10 @@ def make_topology(cfg: MeshConfig | None = None,
                   devices: Sequence[jax.Device] | None = None) -> Topology:
     """Build the device mesh.
 
-    Axes: (replica, model, seq). Data parallelism rides ``replica``;
-    ``model``/``seq`` are reserved for tensor/sequence parallelism and
-    default to size 1, so adding TP/SP later is a reshape, not a
-    redesign (SURVEY §5.7, §7).
+    Axes: (replica, model, seq, stage). Data parallelism rides
+    ``replica``; ``model`` carries Megatron tensor parallelism, ``seq``
+    ring/all-to-all sequence parallelism, ``stage`` GPipe layer
+    pipelining. Unused axes default to size 1.
     """
     cfg = cfg or MeshConfig()
     if (devices is None and cfg.simulate_devices > 0
@@ -168,20 +169,23 @@ def make_topology(cfg: MeshConfig | None = None,
         simulate_devices(cfg.simulate_devices)
     devs = list(devices if devices is not None else jax.devices())
     mp, sp = max(1, cfg.model_parallelism), max(1, cfg.seq_parallelism)
+    pp = max(1, cfg.pipeline_parallelism)
     n = cfg.num_replicas
     if n == -1:
-        n = len(devs) // (mp * sp)
-    want = n * mp * sp
+        n = len(devs) // (mp * sp * pp)
+    want = n * mp * sp * pp
     if want > len(devs):
         raise ValueError(
-            f"mesh needs {want} devices (replica={n} × model={mp} × seq={sp}) "
-            f"but only {len(devs)} are visible")
-    grid = np.array(devs[:want]).reshape(n, mp, sp)
-    mesh = Mesh(grid, (cfg.replica_axis, cfg.model_axis, cfg.seq_axis))
+            f"mesh needs {want} devices (replica={n} × model={mp} × seq={sp} "
+            f"× stage={pp}) but only {len(devs)} are visible")
+    grid = np.array(devs[:want]).reshape(n, mp, sp, pp)
+    mesh = Mesh(grid, (cfg.replica_axis, cfg.model_axis, cfg.seq_axis,
+                       cfg.stage_axis))
     return Topology(mesh=mesh,
                     replica_axis=cfg.replica_axis,
                     model_axis=cfg.model_axis,
-                    seq_axis=cfg.seq_axis)
+                    seq_axis=cfg.seq_axis,
+                    stage_axis=cfg.stage_axis)
 
 
 def make_seq_topology(n_seq: int, devices: Sequence[jax.Device] | None = None) -> Topology:

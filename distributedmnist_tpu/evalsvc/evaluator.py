@@ -51,7 +51,7 @@ class Evaluator:
             cfg.data, cfg.model.image_size, cfg.model.num_channels,
             cfg.model.num_classes, cfg.model.seq_len, cfg.model.vocab_size)
         self.eval_fn = build_eval_step(self.model, cfg, self.topo)
-        self.template = init_train_state(self.model, cfg)
+        self.template = init_train_state(self.model, cfg, self.topo)
         self.last_step_evaluated = -1
         self._sink: JsonlSink | None = None
 

@@ -77,6 +77,14 @@ class Model:
     # factory(model_axis) -> params-shaped pytree of PartitionSpec for
     # tensor-parallel parameter placement.
     tp_param_specs: Callable[[str], Any] | None = None
+    # Pipeline-parallel support: pp_transform restacks init params into
+    # the layer-stacked layout; pp_param_specs(stage_axis) are its
+    # placement specs; pp_apply_factory(stage_axis, num_microbatches)
+    # -> apply(params, tokens) -> logits inside shard_map.
+    pp_transform: Callable[[Any], Any] | None = None
+    pp_param_specs: Callable[[str], Any] | None = None
+    pp_apply_factory: (Callable[[str, int], Callable[..., jax.Array]]
+                       | None) = None
 
 
 _REGISTRY: dict[str, Callable[[ModelConfig], Model]] = {}
@@ -195,10 +203,21 @@ def _transformer(cfg: ModelConfig) -> Model:
 
         return apply_sharded
 
+    def pp_apply_factory(stage_axis: str, num_microbatches: int):
+        def apply_pp(params, tokens):
+            return transformer.apply_pp(
+                params, tokens, num_heads=cfg.num_heads,
+                stage_axis=stage_axis, num_microbatches=num_microbatches,
+                attention_fn=attention_fn, compute_dtype=compute_dtype)
+        return apply_pp
+
     return Model(name=cfg.name, init=init, apply=apply,
                  loss=transformer.loss_fn, accuracy=transformer.accuracy,
                  input_shape=(cfg.seq_len,), input_dtype=jnp.int32,
                  eval_metrics=lm_eval_metrics,
                  sharded_apply_factory=sharded_apply_factory,
                  tp_param_specs=lambda axis: transformer.param_partition_specs(
-                     cfg.num_layers, axis))
+                     cfg.num_layers, axis),
+                 pp_transform=transformer.stack_block_params,
+                 pp_param_specs=transformer.pp_param_partition_specs,
+                 pp_apply_factory=pp_apply_factory)

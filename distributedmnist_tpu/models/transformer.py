@@ -112,26 +112,105 @@ def apply(params: Params, tokens: jax.Array, *, num_heads: int = 4,
                          f"model-parallel size {m}")
     h_local = num_heads // m
     for blk in p["blocks"]:
-        h = _rms_norm(x, blk["ln1"])
-        qkv = jnp.einsum("bsd,dte->bste", h, blk["wqkv"])  # e = d/m
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-
-        def heads(t):
-            return t.reshape(b, -1, h_local, hd).transpose(0, 2, 1, 3)
-
-        o = attn(heads(q), heads(k), heads(v))
-        o = o.transpose(0, 2, 1, 3).reshape(b, -1, d // m)
-        proj = o @ blk["wo"]  # row-parallel: partial sum of the full d
-        if model_axis:
-            proj = lax.psum(proj, model_axis)
-        x = x + proj
-        h = _rms_norm(x, blk["ln2"])
-        mlp = jax.nn.relu(h @ blk["w1"]) @ blk["w2"]
-        if model_axis:
-            mlp = lax.psum(mlp, model_axis)
-        x = x + mlp
+        x = _apply_block(x, blk, h_local=h_local, hd=hd, attn=attn,
+                         model_axis=model_axis)
     x = _rms_norm(x, p["final_norm"])
     logits = x @ p["embed"].T  # tied head
+    return logits.astype(jnp.float32)
+
+
+def _apply_block(x: jax.Array, blk: Params, *, h_local: int, hd: int,
+                 attn: Callable, model_axis: str | None) -> jax.Array:
+    """One pre-norm transformer block (shared by the dense/TP loop and
+    the pipeline stage scan)."""
+    b = x.shape[0]
+    h = _rms_norm(x, blk["ln1"])
+    qkv = jnp.einsum("bsd,dte->bste", h, blk["wqkv"])  # e = d/m
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+    def heads(t):
+        return t.reshape(b, -1, h_local, hd).transpose(0, 2, 1, 3)
+
+    o = attn(heads(q), heads(k), heads(v))
+    o = o.transpose(0, 2, 1, 3).reshape(b, -1, h_local * hd)
+    proj = o @ blk["wo"]  # row-parallel: partial sum of the full d
+    if model_axis:
+        proj = lax.psum(proj, model_axis)
+    x = x + proj
+    h = _rms_norm(x, blk["ln2"])
+    mlp = jax.nn.relu(h @ blk["w1"]) @ blk["w2"]
+    if model_axis:
+        mlp = lax.psum(mlp, model_axis)
+    return x + mlp
+
+
+# ---------------------------------------------------------------------------
+# Pipeline parallelism: layer-stacked params + microbatched apply
+# ---------------------------------------------------------------------------
+
+def stack_block_params(params: Params) -> Params:
+    """Convert ``blocks`` from a list of per-layer dicts to one dict of
+    leaves stacked on a leading layer dim — the shardable layout for a
+    mesh ``stage`` axis (layer dim split across stages)."""
+    blocks = params["blocks"]
+    stacked = jax.tree.map(lambda *leaves: jnp.stack(leaves), *blocks)
+    return {**{k: v for k, v in params.items() if k != "blocks"},
+            "blocks": stacked}
+
+
+def pp_param_partition_specs(stage_axis: str) -> Params:
+    """Stacked-layout specs: block leaves sharded on the layer dim over
+    the stage axis; embeddings/norms replicated (their gradients psum
+    over stages via the AD transpose of the replication)."""
+    P = PartitionSpec
+    blk = {"ln1": {"scale": P(stage_axis)}, "wqkv": P(stage_axis),
+           "wo": P(stage_axis), "ln2": {"scale": P(stage_axis)},
+           "w1": P(stage_axis), "w2": P(stage_axis)}
+    return {"embed": P(), "pos": P(), "blocks": blk,
+            "final_norm": {"scale": P()}}
+
+
+def apply_pp(params: Params, tokens: jax.Array, *, num_heads: int,
+             stage_axis: str, num_microbatches: int,
+             attention_fn: Callable | None = None,
+             positions: jax.Array | None = None,
+             compute_dtype=jnp.bfloat16) -> jax.Array:
+    """Pipeline-parallel forward (inside shard_map, params in the
+    stacked layout with block leaves sharded over ``stage_axis``).
+
+    The batch is split into ``num_microbatches``; each stage scans its
+    local layer slice; activations flow via the microbatch pipeline
+    (ops/pipeline.py). Embedding/head run replicated on every stage —
+    outputs are stage-replicated logits, so loss code is unchanged.
+    """
+    from ..ops.pipeline import pipeline_apply
+
+    attn = attention_fn or local_self_attention
+    b, s = tokens.shape
+    if b % num_microbatches != 0:
+        raise ValueError(f"batch {b} not divisible by "
+                         f"num_microbatches={num_microbatches}")
+    if positions is None:
+        positions = jnp.arange(s)
+    p = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+    d = p["embed"].shape[-1]
+    hd = d // num_heads
+    x = p["embed"][tokens] + p["pos"][positions]
+    mb = b // num_microbatches
+    micro = x.reshape(num_microbatches, mb, s, d)
+
+    def stage_fn(act):
+        def layer(carry, blk):
+            return _apply_block(carry, blk, h_local=num_heads, hd=hd,
+                                attn=attn, model_axis=None), None
+
+        out, _ = lax.scan(layer, act, p["blocks"])
+        return out
+
+    out = pipeline_apply(stage_fn, micro, stage_axis)
+    x = out.reshape(b, s, d)
+    x = _rms_norm(x, p["final_norm"])
+    logits = x @ p["embed"].T
     return logits.astype(jnp.float32)
 
 

@@ -1,0 +1,80 @@
+"""GPipe-style pipeline parallelism over a mesh ``stage`` axis.
+
+The fourth parallelism family (after data/tensor/sequence — all absent
+from the reference, SURVEY §2.2): layers are sharded across stages,
+activations flow stage→stage over ``lax.ppermute`` (neighbor ICI
+links), and the batch is split into microbatches so stages overlap
+work on different microbatches instead of idling.
+
+SPMD formulation: every device runs the same scanned program for
+``M + S - 1`` ticks. Each tick, a stage applies ITS layer slice to the
+activation in its buffer, the last stage banks finished microbatches,
+and a ppermute shifts activations one stage forward while stage 0
+injects the next microbatch. Warm-up/drain bubbles process zeros whose
+results are never banked (the later, valid write of each slot lands
+after any bubble write). Expressed with ``lax.scan`` end to end, so the
+whole pipeline — including the bubbles — is reverse-mode
+differentiable; the ppermute transposes to the reverse rotation in the
+backward pass, giving the classic backward pipeline for free.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn: Callable[[jax.Array], jax.Array],
+                   microbatches: jax.Array, axis_name: str) -> jax.Array:
+    """Run sharded-by-layer ``stage_fn`` as a microbatch pipeline.
+
+    Args:
+      stage_fn: applies THIS device's layer slice:
+        activations [mb, ...] → activations [mb, ...] (same shape).
+      microbatches: [M, mb, ...] — the embedded inputs; only stage 0's
+        values are consumed (other stages may hold the same array).
+      axis_name: the mesh stage axis (inside shard_map).
+
+    Returns [M, mb, ...] final-stage outputs, REPLICATED over the stage
+    axis (a masked psum broadcasts them), so downstream loss/head code
+    runs identically on every stage.
+    """
+    s = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    perm = [(i, (i + 1) % s) for i in range(s)]
+
+    def vary_like(x, ref):
+        want = getattr(jax.typeof(ref), "vma", frozenset()) or frozenset()
+        have = getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+        missing = tuple(want - have)
+        return lax.pcast(x, missing, to="varying") if missing else x
+
+    buf0 = jnp.where(me == 0, microbatches[0], jnp.zeros_like(microbatches[0]))
+    outs0 = jnp.zeros_like(microbatches)
+    # probe one stage application so carries match the scan body's vma
+    ref = stage_fn(buf0)
+    buf0 = vary_like(buf0, ref)
+    outs0 = vary_like(outs0, ref)
+
+    def tick(carry, t):
+        buf, outs = carry
+        y = stage_fn(buf)
+        # last stage banks microbatch (t - (s-1)) once it's really done;
+        # bubble writes clobber slot 0 early but the valid write lands later
+        idx = jnp.clip(t - (s - 1), 0, m - 1)
+        banked = lax.dynamic_update_index_in_dim(outs, y, idx, 0)
+        outs = jnp.where(me == s - 1, banked, outs)
+        # shift forward; stage 0 injects the next microbatch
+        shifted = lax.ppermute(y, axis_name, perm)
+        nxt = jnp.clip(t + 1, 0, m - 1)
+        buf = jnp.where(me == 0, microbatches[nxt], shifted)
+        return (buf, outs), None
+
+    (_, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(m + s - 1))
+    # broadcast the last stage's banked outputs to every stage
+    mask = (me == s - 1).astype(outs.dtype)
+    return lax.psum(outs * mask, axis_name)

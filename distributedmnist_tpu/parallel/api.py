@@ -69,12 +69,20 @@ def state_partition_specs(model: Model, cfg: ExperimentConfig,
     from jax.sharding import PartitionSpec as P_
 
     n_model = topo.mesh.shape[topo.model_axis]
+    n_stage = topo.mesh.shape[topo.stage_axis]
     if n_model > 1 and getattr(model, "tp_param_specs", None) is None:
         raise ValueError(f"mesh has model_parallelism={n_model} but model "
                          f"{model.name!r} has no tensor-parallel parameter "
                          "specs")
-    pspec: Any = (model.tp_param_specs(topo.model_axis) if n_model > 1
-                  else P_())
+    if n_stage > 1 and getattr(model, "pp_param_specs", None) is None:
+        raise ValueError(f"mesh has pipeline_parallelism={n_stage} but model "
+                         f"{model.name!r} has no pipeline parameter specs")
+    if n_stage > 1:
+        pspec: Any = model.pp_param_specs(topo.stage_axis)
+    elif n_model > 1:
+        pspec = model.tp_param_specs(topo.model_axis)
+    else:
+        pspec = P_()
     has_momentum = cfg.optim.momentum > 0.0
     interval = cfg.sync.mode == "interval"
     return TrainState(
@@ -85,8 +93,14 @@ def state_partition_specs(model: Model, cfg: ExperimentConfig,
         window_rounds=P_(), wall_ms=P_(), next_apply_ms=P_())
 
 
-def init_train_state(model: Model, cfg: ExperimentConfig) -> TrainState:
+def init_train_state(model: Model, cfg: ExperimentConfig,
+                     topo: Topology | None = None) -> TrainState:
     params = model.init(jax.random.PRNGKey(cfg.model.init_seed))
+    if (topo is not None and topo.mesh.shape[topo.stage_axis] > 1):
+        if getattr(model, "pp_transform", None) is None:
+            raise ValueError(f"mesh has pipeline stages but model "
+                             f"{model.name!r} has no pp_transform")
+        params = model.pp_transform(params)  # layer-stacked layout
     momentum = (jax.tree.map(jnp.zeros_like, params)
                 if cfg.optim.momentum > 0.0 else None)
     interval = cfg.sync.mode == "interval"
@@ -169,9 +183,24 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
             f"mesh has seq_parallelism={n_seq} / model_parallelism="
             f"{n_model} but model {model.name!r} supports neither "
             "(no sharded_apply_factory)")
-    if n_model > 1 and getattr(model, "tp_param_specs", None) is None:
-        raise ValueError(f"model {model.name!r} has no tensor-parallel "
-                         "parameter specs")
+    # Pipeline parallelism: layers sharded over the stage axis, batch
+    # microbatched through the activation pipeline (ops/pipeline.py).
+    # Stage-sharded param grads stay local; replicated leaves (embed,
+    # norms) get their stage-psum from the AD transpose of replication.
+    stage_ax = topo.stage_axis
+    n_stage = topo.mesh.shape[stage_ax]
+    if n_stage > 1:
+        if getattr(model, "pp_apply_factory", None) is None:
+            raise ValueError(f"mesh has pipeline_parallelism={n_stage} but "
+                             f"model {model.name!r} has no pipeline apply")
+        if n_seq > 1 or n_model > 1:
+            raise ValueError(
+                "pipeline parallelism currently composes with data "
+                "parallelism only (set model_parallelism=seq_parallelism=1)")
+        pp_apply = model.pp_apply_factory(stage_ax,
+                                          cfg.mesh.pipeline_microbatches)
+    else:
+        pp_apply = None
     sharded_apply = (model.sharded_apply_factory(
         seq_ax if n_seq > 1 else None, model_ax if n_model > 1 else None)
         if (n_seq > 1 or n_model > 1) else None)
@@ -184,6 +213,11 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
     def local_loss(params, batch, dropout_key):
         logits = model.apply(params, batch["image"], train=True,
                              dropout_key=dropout_key)
+        return model.loss(logits, batch["label"]), logits
+
+    def local_loss_pp(params, batch, dropout_key):
+        del dropout_key
+        logits = pp_apply(params, batch["image"])  # stage-replicated
         return model.loss(logits, batch["label"]), logits
 
     def local_loss_sp(params, batch, dropout_key):
@@ -241,6 +275,10 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
             loss = lax.psum(loss_p, seq_ax)
             train_acc = lax.psum(acc_p, seq_ax)
             grads = jax.tree.map(lambda g: lax.psum(g, seq_ax), grads)
+        elif pp_apply is not None:
+            (loss, logits), grads = jax.value_and_grad(
+                local_loss_pp, has_aux=True)(local_params, batch, dkey)
+            train_acc = model.accuracy(logits, batch["label"])
         else:
             (loss, logits), grads = jax.value_and_grad(
                 local_loss, has_aux=True)(local_params, batch, dkey)
@@ -373,7 +411,19 @@ def build_eval_step(model: Model, cfg: ExperimentConfig, topo: Topology):
     axis = topo.replica_axis
     model_ax = topo.model_axis
     n_model = topo.mesh.shape[model_ax]
-    if n_model > 1:
+    n_stage = topo.mesh.shape[topo.stage_axis]
+    if n_stage > 1:
+        # pipeline-parallel params: stacked layout, microbatch M=1
+        # (latency is irrelevant for eval; correctness is identical)
+        if getattr(model, "pp_apply_factory", None) is None:
+            raise ValueError(f"mesh has pipeline_parallelism={n_stage} but "
+                             f"model {model.name!r} has no pipeline apply")
+        pspec: Any = model.pp_param_specs(topo.stage_axis)
+        eval_pp_apply = model.pp_apply_factory(topo.stage_axis, 1)
+
+        def run(params, images):
+            return eval_pp_apply(params, images)
+    elif n_model > 1:
         # tensor-parallel params: sharded apply (full sequence per
         # device — eval batches are not seq-sharded), sharded in_spec
         if (getattr(model, "tp_param_specs", None) is None

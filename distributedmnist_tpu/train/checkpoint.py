@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from pathlib import Path
 from typing import Any
@@ -60,6 +61,98 @@ def save_checkpoint(train_dir: str | Path, state: Any, step: int,
     _garbage_collect(train_dir, keep)
     logger.info("saved checkpoint step=%d → %s", step, path.name)
     return path
+
+
+class AsyncCheckpointer:
+    """Background-thread checkpoint writer.
+
+    The reference's Supervisor saves synchronously from its own timer
+    thread (src/distributed_train.py:244-252); here the *train loop*
+    triggers saves, so serialization + file IO must not stall the step
+    cadence. ``save`` fetches state to host synchronously (the step
+    function donates its input buffers, so a background device read
+    would race with donation) and hands the numpy pytree to a worker
+    that msgpacks and writes it. Latest-wins: if a save is still in
+    flight when the next one arrives, the pending one is replaced —
+    checkpoints are snapshots, not a journal. Worker errors surface on
+    the next ``save``/``wait``.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending: tuple | None = None
+        self._busy = False
+        self._error: Exception | None = None  # last write's outcome
+        self._wake = threading.Condition(self._lock)
+        self._stop = False
+        self.closed = False
+        self._thread = threading.Thread(target=self._worker, daemon=True,
+                                        name="ckpt-writer")
+        self._thread.start()
+
+    def _worker(self) -> None:
+        while True:
+            with self._wake:
+                while self._pending is None and not self._stop:
+                    self._wake.wait()
+                if self._stop and self._pending is None:
+                    return
+                job = self._pending
+                self._pending = None
+                self._busy = True
+            try:
+                save_checkpoint(*job)
+            except Exception as e:
+                # Log NOW (the failure may otherwise go unnoticed for
+                # hours of training); also kept for wait() to raise.
+                logger.error("async checkpoint write for step=%d failed: %s",
+                             job[2], e)
+                with self._lock:
+                    self._error = e
+            else:
+                with self._lock:
+                    self._error = None  # a later success supersedes
+            finally:
+                with self._wake:
+                    self._busy = False
+                    self._wake.notify_all()
+
+    def _raise_pending_error(self) -> None:
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise RuntimeError("async checkpoint write failed") from err
+
+    def save(self, train_dir: str | Path, state: Any, step: int,
+             extra: dict | None = None, keep: int = 5) -> None:
+        """Queue a write. Never raises for an earlier write's failure —
+        that already went to the log and a later save may well succeed
+        (transient disk pressure); ``wait`` raises if the LAST write
+        failed, so a broken final checkpoint is never silent."""
+        host_state = jax.device_get(state)  # sync: buffers get donated next step
+        with self._wake:
+            if self.closed:
+                raise RuntimeError("AsyncCheckpointer is closed")
+            if self._pending is not None:
+                logger.warning("checkpoint writer lagging; replacing queued "
+                               "step=%d with step=%d", self._pending[2], step)
+            self._pending = (train_dir, host_state, step, extra, keep)
+            self._wake.notify_all()
+
+    def wait(self) -> None:
+        """Drain in-flight writes (call before exit / final save)."""
+        with self._wake:
+            while self._pending is not None or self._busy:
+                self._wake.wait()
+        self._raise_pending_error()
+
+    def close(self) -> None:
+        self.wait()
+        with self._wake:
+            self._stop = True
+            self.closed = True
+            self._wake.notify_all()
+        self._thread.join(timeout=60)
 
 
 def _garbage_collect(train_dir: Path, keep: int) -> None:

@@ -57,6 +57,17 @@ class Trainer:
         if self.seq_sharded and cfg.model.seq_len % n_seq != 0:
             raise ValueError(f"seq_len {cfg.model.seq_len} not divisible by "
                              f"seq_parallelism {n_seq}")
+        n_stage = self.topo.mesh.shape[self.topo.stage_axis]
+        if n_stage > 1:
+            mb = cfg.mesh.pipeline_microbatches
+            if (cfg.data.batch_size // n) % mb != 0:
+                raise ValueError(
+                    f"per-replica batch {cfg.data.batch_size // n} not "
+                    f"divisible by pipeline_microbatches {mb}")
+            if cfg.model.num_layers % n_stage != 0:
+                raise ValueError(
+                    f"num_layers {cfg.model.num_layers} not divisible by "
+                    f"pipeline_parallelism {n_stage}")
         from ..parallel.policies import resolve_aggregate_k
         k = resolve_aggregate_k(cfg.sync, n)
         # LR schedule keyed to applied updates; decay_steps ÷ k
@@ -74,7 +85,7 @@ class Trainer:
         self.step_fn = build_train_step(self.model, cfg, self.topo, self.schedule)
         self.eval_fn = build_eval_step(self.model, cfg, self.topo)
         self.state_specs = state_partition_specs(self.model, cfg, self.topo)
-        self.state: TrainState = init_train_state(self.model, cfg)
+        self.state: TrainState = init_train_state(self.model, cfg, self.topo)
         self.state = self.topo.device_put_state(self.state, self.state_specs)
 
         self.train_iter = make_train_iterator(
@@ -84,6 +95,8 @@ class Trainer:
         self.collector = StepTimeCollector(num_replicas=n)
         self.is_writer = jax.process_index() == 0
         self.train_dir = Path(cfg.train.train_dir)
+        self._use_async_ckpt = cfg.train.async_checkpoint and self.is_writer
+        self._checkpointer: ckpt.AsyncCheckpointer | None = None
         self._sink: JsonlSink | None = None
         self._series: list[tuple[float, int, float, float]] = []  # (t, step, loss, acc)
         self._last_save_time = time.time()
@@ -117,9 +130,17 @@ class Trainer:
         iter_state = getattr(self.train_iter, "state", None)
         if callable(iter_state):
             extra["data_iter"] = self.train_iter.state()
-        ckpt.save_checkpoint(self.train_dir, self.state,
-                             int(jax.device_get(self.state.step)),
-                             extra=extra, keep=self.cfg.train.keep_checkpoints)
+        at_step = int(jax.device_get(self.state.step))
+        if self._use_async_ckpt:
+            if self._checkpointer is None or self._checkpointer.closed:
+                self._checkpointer = ckpt.AsyncCheckpointer()
+            self._checkpointer.save(self.train_dir, self.state, at_step,
+                                    extra=extra,
+                                    keep=self.cfg.train.keep_checkpoints)
+        else:
+            ckpt.save_checkpoint(self.train_dir, self.state, at_step,
+                                 extra=extra,
+                                 keep=self.cfg.train.keep_checkpoints)
         self._last_save_time = time.time()
 
     def _sink_write(self, record: dict) -> None:
@@ -238,6 +259,11 @@ class Trainer:
             jax.profiler.stop_trace()
         # final save (≙ chief final saver.save, src/distributed_train.py:405-408)
         self._save(step)
+        if self._checkpointer is not None:
+            # drain + join the writer thread (a sweep builds many
+            # Trainers in one process); raises if the final write failed
+            self._checkpointer.close()
+            self._checkpointer = None
         self._dump_series()
         if self._sink:
             self._sink.close()
