@@ -75,6 +75,12 @@ class ModelConfig:
     # (all-to-all head scatter; needs num_heads % seq_parallelism == 0,
     # composes with the flash kernel).
     sp_attention: str = "ring"
+    # Mixture-of-experts FFNs (transformer): 0 = dense MLP. With
+    # mesh.model_parallelism > 1 the model axis carries the experts
+    # (expert parallelism) instead of attention heads.
+    num_experts: int = 0
+    expert_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
 
 @dataclass(frozen=True)

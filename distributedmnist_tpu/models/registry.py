@@ -85,6 +85,11 @@ class Model:
     pp_param_specs: Callable[[str], Any] | None = None
     pp_apply_factory: (Callable[[str, int], Callable[..., jax.Array]]
                        | None) = None
+    # Auxiliary loss (MoE load balancing): when True, ``apply`` and the
+    # sharded applies accept ``return_aux=True`` and return
+    # (logits, aux); the train step adds ``aux_weight * aux``.
+    has_aux: bool = False
+    aux_weight: float = 0.0
 
 
 _REGISTRY: dict[str, Callable[[ModelConfig], Model]] = {}
@@ -151,11 +156,13 @@ def _transformer(cfg: ModelConfig) -> Model:
     from . import transformer
     compute_dtype = jnp.dtype(cfg.compute_dtype)
 
+    moe = cfg.num_experts > 0
+
     def init(key):
         return transformer.init(
             key, vocab_size=cfg.vocab_size, model_dim=cfg.model_dim,
             num_heads=cfg.num_heads, num_layers=cfg.num_layers,
-            max_seq_len=cfg.seq_len)
+            max_seq_len=cfg.seq_len, num_experts=cfg.num_experts)
 
     if cfg.attention_impl == "flash":
         from ..ops.pallas_attention import flash_attention
@@ -165,11 +172,14 @@ def _transformer(cfg: ModelConfig) -> Model:
     else:
         raise ValueError(f"unknown attention_impl {cfg.attention_impl!r}")
 
-    def apply(params, x, *, train=False, dropout_key=None):
+    def apply(params, x, *, train=False, dropout_key=None, return_aux=False):
         del dropout_key
         return transformer.apply(params, x, num_heads=cfg.num_heads,
                                  attention_fn=attention_fn,
-                                 compute_dtype=compute_dtype)
+                                 compute_dtype=compute_dtype,
+                                 num_experts=cfg.num_experts,
+                                 capacity_factor=cfg.expert_capacity_factor,
+                                 return_aux=return_aux)
 
     def sharded_apply_factory(seq_axis: str | None, model_axis: str | None):
         """Sharded apply for the DP×SP×TP train step: tokens arrive as
@@ -194,16 +204,34 @@ def _transformer(cfg: ModelConfig) -> Model:
         else:
             raise ValueError(f"unknown sp_attention {cfg.sp_attention!r}")
 
-        def apply_sharded(params, tokens, positions):
+        if moe and seq_axis is not None:
+            raise ValueError("mixture-of-experts does not yet compose with "
+                             "sequence parallelism (capacity would become "
+                             "shard-local)")
+        # with MoE, the model axis carries EXPERTS (expert parallelism),
+        # not attention heads
+        tp_axis = None if moe else model_axis
+        ep_axis = model_axis if moe else None
+
+        def apply_sharded(params, tokens, positions, return_aux=False):
             return transformer.apply(params, tokens, num_heads=cfg.num_heads,
                                      attention_fn=sharded_attn,
                                      positions=positions,
                                      compute_dtype=compute_dtype,
-                                     model_axis=model_axis)
+                                     model_axis=tp_axis,
+                                     expert_axis=ep_axis,
+                                     num_experts=cfg.num_experts,
+                                     capacity_factor=cfg.expert_capacity_factor,
+                                     return_aux=return_aux)
 
         return apply_sharded
 
     def pp_apply_factory(stage_axis: str, num_microbatches: int):
+        if moe:
+            raise ValueError("mixture-of-experts does not yet compose with "
+                             "pipeline parallelism (aux loss cannot cross "
+                             "the stage pipeline)")
+
         def apply_pp(params, tokens):
             return transformer.apply_pp(
                 params, tokens, num_heads=cfg.num_heads,
@@ -216,8 +244,9 @@ def _transformer(cfg: ModelConfig) -> Model:
                  input_shape=(cfg.seq_len,), input_dtype=jnp.int32,
                  eval_metrics=lm_eval_metrics,
                  sharded_apply_factory=sharded_apply_factory,
+                 has_aux=moe, aux_weight=cfg.moe_aux_weight,
                  tp_param_specs=lambda axis: transformer.param_partition_specs(
-                     cfg.num_layers, axis),
+                     cfg.num_layers, axis, cfg.num_experts),
                  pp_transform=transformer.stack_block_params,
                  pp_param_specs=transformer.pp_param_partition_specs,
                  pp_apply_factory=pp_apply_factory)

@@ -34,9 +34,11 @@ Params = dict[str, Any]
 
 def init(key: jax.Array, vocab_size: int = 256, model_dim: int = 128,
          num_heads: int = 4, num_layers: int = 2,
-         max_seq_len: int = 512) -> Params:
+         max_seq_len: int = 512, num_experts: int = 0) -> Params:
+    """``num_experts > 0`` makes every block's FFN a top-1-routed
+    mixture of experts (ops/moe.py) instead of a dense MLP."""
     assert model_dim % num_heads == 0
-    keys = iter(jax.random.split(key, 4 + 4 * num_layers))
+    keys = iter(jax.random.split(key, 4 + 5 * num_layers))
     scale = 0.02
     params: Params = {
         "embed": truncated_normal_init(next(keys), (vocab_size, model_dim), scale),
@@ -44,8 +46,9 @@ def init(key: jax.Array, vocab_size: int = 256, model_dim: int = 128,
         "blocks": [],
         "final_norm": {"scale": jnp.ones((model_dim,), jnp.float32)},
     }
+    ff = 4 * model_dim
     for _ in range(num_layers):
-        params["blocks"].append({
+        blk = {
             "ln1": {"scale": jnp.ones((model_dim,), jnp.float32)},
             # [d, 3, d] (not [d, 3d]): the last dim is the shardable
             # per-head output dim, so a model-axis column shard keeps
@@ -53,26 +56,49 @@ def init(key: jax.Array, vocab_size: int = 256, model_dim: int = 128,
             "wqkv": truncated_normal_init(next(keys), (model_dim, 3, model_dim), scale),
             "wo": truncated_normal_init(next(keys), (model_dim, model_dim), scale),
             "ln2": {"scale": jnp.ones((model_dim,), jnp.float32)},
-            "w1": truncated_normal_init(next(keys), (model_dim, 4 * model_dim), scale),
-            "w2": truncated_normal_init(next(keys), (4 * model_dim, model_dim), scale),
-        })
+        }
+        if num_experts > 0:
+            blk["router"] = truncated_normal_init(
+                next(keys), (model_dim, num_experts), scale)
+            k1, k2 = jax.random.split(next(keys))
+            blk["w1"] = truncated_normal_init(k1, (num_experts, model_dim, ff), scale)
+            blk["w2"] = truncated_normal_init(k2, (num_experts, ff, model_dim), scale)
+        else:
+            blk["w1"] = truncated_normal_init(next(keys), (model_dim, ff), scale)
+            blk["w2"] = truncated_normal_init(next(keys), (ff, model_dim), scale)
+        params["blocks"].append(blk)
     return params
 
 
-def param_partition_specs(num_layers: int, model_axis: str) -> Params:
-    """Megatron TP layout: qkv & MLP-in column-parallel (output dim
-    sharded), their consumers wo & MLP-out row-parallel (input dim
-    sharded → one psum each per block); embeddings and norms replicated."""
+def param_partition_specs(num_layers: int, model_axis: str,
+                          num_experts: int = 0) -> Params:
+    """Model-axis placement.
+
+    Dense FFN → Megatron TP layout: qkv & MLP-in column-parallel
+    (output dim sharded), their consumers wo & MLP-out row-parallel
+    (input dim sharded → one psum each per block); embeddings and norms
+    replicated.
+
+    MoE (num_experts > 0) → expert parallelism: the axis carries the
+    EXPERT dim of w1/w2; attention and the router stay replicated."""
     P = PartitionSpec
-    blocks = [{
-        "ln1": {"scale": P()},
-        "wqkv": P(None, None, model_axis),
-        "wo": P(model_axis, None),
-        "ln2": {"scale": P()},
-        "w1": P(None, model_axis),
-        "w2": P(model_axis, None),
-    } for _ in range(num_layers)]
-    return {"embed": P(), "pos": P(), "blocks": blocks,
+    if num_experts > 0:
+        blk = {
+            "ln1": {"scale": P()}, "wqkv": P(), "wo": P(),
+            "ln2": {"scale": P()}, "router": P(),
+            "w1": P(model_axis, None, None),
+            "w2": P(model_axis, None, None),
+        }
+    else:
+        blk = {
+            "ln1": {"scale": P()},
+            "wqkv": P(None, None, model_axis),
+            "wo": P(model_axis, None),
+            "ln2": {"scale": P()},
+            "w1": P(None, model_axis),
+            "w2": P(model_axis, None),
+        }
+    return {"embed": P(), "pos": P(), "blocks": [dict(blk) for _ in range(num_layers)],
             "final_norm": {"scale": P()}}
 
 
@@ -85,7 +111,10 @@ def apply(params: Params, tokens: jax.Array, *, num_heads: int = 4,
           attention_fn: Callable | None = None,
           positions: jax.Array | None = None,
           compute_dtype=jnp.bfloat16,
-          model_axis: str | None = None) -> jax.Array:
+          model_axis: str | None = None,
+          expert_axis: str | None = None, num_experts: int = 0,
+          capacity_factor: float = 1.25,
+          return_aux: bool = False) -> jax.Array:
     """tokens [batch, seq] int32 → logits [batch, seq, vocab] float32.
 
     ``positions`` (global positions of this shard's tokens) must be
@@ -97,6 +126,11 @@ def apply(params: Params, tokens: jax.Array, *, num_heads: int = 4,
     slice; row-parallel projections psum partial sums back to the full
     residual. Activations stay replicated over the axis, so the logits
     (and any loss) are identical on every TP rank.
+
+    ``expert_axis``/``num_experts``: mixture-of-experts FFNs with the
+    experts sharded over the axis (expert parallelism — mutually
+    exclusive with ``model_axis``, which carries heads).
+    ``return_aux``: also return the summed load-balancing aux loss.
     """
     attn = attention_fn or local_self_attention
     b, s = tokens.shape
@@ -111,18 +145,26 @@ def apply(params: Params, tokens: jax.Array, *, num_heads: int = 4,
         raise ValueError(f"num_heads={num_heads} not divisible by "
                          f"model-parallel size {m}")
     h_local = num_heads // m
+    aux_total = jnp.zeros((), jnp.float32)
     for blk in p["blocks"]:
-        x = _apply_block(x, blk, h_local=h_local, hd=hd, attn=attn,
-                         model_axis=model_axis)
+        x, aux = _apply_block(x, blk, h_local=h_local, hd=hd, attn=attn,
+                              model_axis=model_axis,
+                              expert_axis=expert_axis,
+                              num_experts=num_experts,
+                              capacity_factor=capacity_factor)
+        aux_total = aux_total + aux
     x = _rms_norm(x, p["final_norm"])
-    logits = x @ p["embed"].T  # tied head
-    return logits.astype(jnp.float32)
+    logits = (x @ p["embed"].T).astype(jnp.float32)  # tied head
+    return (logits, aux_total) if return_aux else logits
 
 
 def _apply_block(x: jax.Array, blk: Params, *, h_local: int, hd: int,
-                 attn: Callable, model_axis: str | None) -> jax.Array:
+                 attn: Callable, model_axis: str | None,
+                 expert_axis: str | None = None, num_experts: int = 0,
+                 capacity_factor: float = 1.25) -> tuple[jax.Array, jax.Array]:
     """One pre-norm transformer block (shared by the dense/TP loop and
-    the pipeline stage scan)."""
+    the pipeline stage scan). Returns (x, moe_aux_loss) — aux is 0 for
+    dense-FFN blocks."""
     b = x.shape[0]
     h = _rms_norm(x, blk["ln1"])
     qkv = jnp.einsum("bsd,dte->bste", h, blk["wqkv"])  # e = d/m
@@ -138,10 +180,18 @@ def _apply_block(x: jax.Array, blk: Params, *, h_local: int, hd: int,
         proj = lax.psum(proj, model_axis)
     x = x + proj
     h = _rms_norm(x, blk["ln2"])
-    mlp = jax.nn.relu(h @ blk["w1"]) @ blk["w2"]
-    if model_axis:
-        mlp = lax.psum(mlp, model_axis)
-    return x + mlp
+    if "router" in blk:
+        from ..ops.moe import moe_ffn
+        mlp, aux = moe_ffn(h, blk["router"], blk["w1"], blk["w2"],
+                           num_experts=num_experts,
+                           capacity_factor=capacity_factor,
+                           expert_axis=expert_axis)
+    else:
+        mlp = jax.nn.relu(h @ blk["w1"]) @ blk["w2"]
+        aux = jnp.zeros((), jnp.float32)
+        if model_axis:
+            mlp = lax.psum(mlp, model_axis)
+    return x + mlp, aux
 
 
 # ---------------------------------------------------------------------------
@@ -201,8 +251,9 @@ def apply_pp(params: Params, tokens: jax.Array, *, num_heads: int,
 
     def stage_fn(act):
         def layer(carry, blk):
-            return _apply_block(carry, blk, h_local=num_heads, hd=hd,
-                                attn=attn, model_axis=None), None
+            out, _aux = _apply_block(carry, blk, h_local=num_heads, hd=hd,
+                                     attn=attn, model_axis=None)
+            return out, None
 
         out, _ = lax.scan(layer, act, p["blocks"])
         return out

@@ -1,0 +1,95 @@
+"""Mixture-of-experts FFN with expert parallelism.
+
+The fifth parallelism family (data/tensor/sequence/pipeline/expert —
+all absent from the reference, SURVEY §2.2). Switch-Transformer-style
+top-1 routing with a fixed per-expert capacity and a load-balancing
+auxiliary loss (cf. arXiv:2101.03961), in the GShard dispatch/combine
+einsum formulation (arXiv:2006.16668) — static shapes throughout, so
+XLA sees two dense batched matmuls per expert shard and the MXU stays
+busy regardless of routing.
+
+Expert-parallel layout mirrors the framework's tensor-parallel
+pattern: activations are REPLICATED over the expert axis, each rank
+holds ``E / axis_size`` experts' weights, computes dispatch/combine
+for its local experts only, and one psum over the axis reassembles the
+combined output. No all-to-all is needed in this layout because tokens
+are already visible to every expert rank; the psum payload is [t, d]
+activations, riding ICI.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def moe_ffn(x: jax.Array, router_w: jax.Array, w1: jax.Array, w2: jax.Array,
+            *, num_experts: int, capacity_factor: float = 1.25,
+            expert_axis: str | None = None) -> tuple[jax.Array, jax.Array]:
+    """Top-1 routed expert FFN.
+
+    Args (inside shard_map when ``expert_axis`` is set):
+      x: [batch, seq, d] activations (replicated over the expert axis).
+      router_w: [d, E] routing weights (replicated).
+      w1: [E_local, d, ff], w2: [E_local, ff, d] — THIS rank's expert
+        slice (E_local = E / axis_size; E_local = E when unsharded).
+      num_experts: E (global).
+      capacity_factor: per-expert capacity = ceil(cf · tokens / E);
+        overflow tokens pass through the residual unchanged (their
+        combine weight is zero).
+
+    Returns (out [batch, seq, d], aux): ``aux`` is the Switch
+    load-balancing loss E·Σ_e(fraction_e · mean_prob_e), ≈1 when
+    perfectly balanced; add ``aux_weight * aux`` to the train loss.
+    """
+    b, s, d = x.shape
+    t = b * s
+    e = num_experts
+    cap = max(1, math.ceil(capacity_factor * t / e))
+    xf = x.reshape(t, d)
+
+    logits = (xf @ router_w.astype(xf.dtype)).astype(jnp.float32)  # [t, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate = jnp.max(probs, axis=-1)                    # [t]
+    choice = jnp.argmax(probs, axis=-1)               # [t]
+    onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # [t, E]
+
+    # load-balance aux: fraction of tokens vs mean router prob per expert
+    aux = e * jnp.sum(jnp.mean(onehot, axis=0) * jnp.mean(probs, axis=0))
+
+    # position of each token within its expert's queue (0-based);
+    # tokens past capacity get a zero dispatch row (dropped -> residual)
+    pos = jnp.sum((jnp.cumsum(onehot, axis=0) - 1.0) * onehot,
+                  axis=-1).astype(jnp.int32)          # [t]
+    slot = jax.nn.one_hot(pos, cap, dtype=jnp.float32)  # [t, C]
+    dispatch = onehot[:, :, None] * slot[:, None, :]    # [t, E, C]
+
+    if expert_axis is not None:
+        e_local = w1.shape[0]
+        me = lax.axis_index(expert_axis)
+        dispatch = lax.dynamic_slice_in_dim(dispatch, me * e_local, e_local,
+                                            axis=1)   # [t, E_local, C]
+    combine = dispatch * gate[:, None, None]
+
+    # routing math stayed f32 above; the FFN FLOPs run in the compute
+    # dtype like the dense branch (bf16 feeds the MXU at full rate)
+    dtype = x.dtype
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(dtype), xf)
+
+    def one_expert(carry, packed):
+        del carry
+        inp, w1_e, w2_e = packed
+        h = jax.nn.relu(inp @ w1_e.astype(dtype))
+        return None, h @ w2_e.astype(dtype)
+
+    _, expert_out = lax.scan(one_expert, None,
+                             (expert_in, w1, w2))     # [E_local, C, d]
+    out = jnp.einsum("tec,ecd->td", combine.astype(dtype), expert_out)
+    if expert_axis is not None:
+        out = lax.psum(out, expert_axis)
+        # (aux needs no reduction: the router is replicated, so every
+        # rank computed the identical value)
+    return out.reshape(b, s, d), aux.astype(jnp.float32)

@@ -210,7 +210,15 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
     grad_axes = (axis, seq_ax) if n_seq > 1 else (axis,)
     state_specs = state_partition_specs(model, cfg, topo)
 
+    has_aux = getattr(model, "has_aux", False)
+    aux_w = getattr(model, "aux_weight", 0.0)
+
     def local_loss(params, batch, dropout_key):
+        if has_aux:
+            logits, aux = model.apply(params, batch["image"], train=True,
+                                      dropout_key=dropout_key,
+                                      return_aux=True)
+            return model.loss(logits, batch["label"]) + aux_w * aux, logits
         logits = model.apply(params, batch["image"], train=True,
                              dropout_key=dropout_key)
         return model.loss(logits, batch["label"]), logits
@@ -236,7 +244,12 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         b, s_loc = tokens.shape
         me_s = lax.axis_index(seq_ax)
         positions = me_s * s_loc + jnp.arange(s_loc)
-        logits = sharded_apply(params, tokens, positions)  # [b, s_loc, V]
+        if has_aux:  # EP path (seq axis is size 1 — guarded in registry)
+            logits, aux = sharded_apply(params, tokens, positions,
+                                        return_aux=True)
+        else:
+            logits = sharded_apply(params, tokens, positions)  # [b, s_loc, V]
+            aux = 0.0
 
         # shard j receives shard (j+1)'s first target column
         perm = [((j + 1) % n_seq, j) for j in range(n_seq)]
@@ -249,7 +262,8 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
         correct = (jnp.argmax(logp, axis=-1) == tgt).astype(jnp.float32)
         total = b * (s_global - 1)  # this replica's global token count
-        return jnp.sum(nll * w) / total, jnp.sum(correct * w) / total
+        return (jnp.sum(nll * w) / total + aux_w * aux,
+                jnp.sum(correct * w) / total)
 
     def shard_fn(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
         me = lax.axis_index(axis)

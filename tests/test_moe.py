@@ -1,0 +1,180 @@
+"""Mixture-of-experts + expert parallelism: routing math vs a manual
+per-token loop, EP-sharded execution vs the dense-MoE oracle (forward
+and one-step update), capacity-overflow behavior, and Trainer e2e."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from conftest import base_config
+from distributedmnist_tpu.core.config import MeshConfig
+from distributedmnist_tpu.core.mesh import make_topology
+from distributedmnist_tpu.models import transformer
+from distributedmnist_tpu.models.registry import get_model
+from distributedmnist_tpu.ops.moe import moe_ffn
+from distributedmnist_tpu.parallel.api import (build_train_step,
+                                               init_train_state,
+                                               state_partition_specs)
+from distributedmnist_tpu.train.lr_schedule import constant
+
+LR = 0.1
+E, D, FF = 4, 8, 16
+
+
+def _moe_weights(key):
+    ks = jax.random.split(key, 3)
+    return (jax.random.normal(ks[0], (D, E)) * 0.5,
+            jax.random.normal(ks[1], (E, D, FF)) * 0.1,
+            jax.random.normal(ks[2], (E, FF, D)) * 0.1)
+
+
+def test_moe_ffn_matches_per_token_loop():
+    router, w1, w2 = _moe_weights(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 6, D))
+    out, aux = moe_ffn(x, router, w1, w2, num_experts=E,
+                       capacity_factor=8.0)  # capacity: nothing dropped
+    xf = np.asarray(x).reshape(-1, D)
+    probs = jax.nn.softmax(xf @ np.asarray(router), axis=-1)
+    want = np.zeros_like(xf)
+    for t in range(xf.shape[0]):
+        e = int(np.argmax(probs[t]))
+        h = np.maximum(xf[t] @ np.asarray(w1)[e], 0.0)
+        want[t] = float(probs[t, e]) * (h @ np.asarray(w2)[e])
+    np.testing.assert_allclose(np.asarray(out).reshape(-1, D), want,
+                               rtol=1e-4, atol=1e-5)
+    assert float(aux) > 0.0 and np.isfinite(float(aux))
+
+
+def test_capacity_overflow_drops_tokens():
+    _, w1, w2 = _moe_weights(jax.random.PRNGKey(2))
+    # positive inputs + positive router column 0 → every token routes
+    # to expert 0 → capacity ceil(cf*t/E) overflows
+    router = jnp.zeros((D, E)).at[:, 0].set(1.0)
+    x = jnp.abs(jax.random.normal(jax.random.PRNGKey(3), (1, 8, D))) + 0.1
+    out, _ = moe_ffn(x, router, w1, w2, num_experts=E, capacity_factor=1.0)
+    # capacity = ceil(1.0 * 8 / 4) = 2 → tokens 2..7 dropped (zero output)
+    norms = np.linalg.norm(np.asarray(out)[0], axis=-1)
+    assert (norms[:2] > 1e-6).all()
+    assert np.allclose(norms[2:], 0.0, atol=1e-6)
+
+
+def test_ep_matches_unsharded():
+    router, w1, w2 = _moe_weights(jax.random.PRNGKey(4))
+    x = jax.random.normal(jax.random.PRNGKey(5), (2, 8, D))
+    want, want_aux = moe_ffn(x, router, w1, w2, num_experts=E,
+                             capacity_factor=2.0)
+
+    topo = make_topology(MeshConfig(num_replicas=1, model_parallelism=4))
+    axis = topo.model_axis
+
+    def fn(x, router, w1, w2):
+        return moe_ffn(x, router, w1, w2, num_experts=E,
+                       capacity_factor=2.0, expert_axis=axis)
+
+    got, got_aux = jax.jit(jax.shard_map(
+        fn, mesh=topo.mesh,
+        in_specs=(P(), P(), P(axis), P(axis)),
+        out_specs=(P(), P())))(x, router, w1, w2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(got_aux), float(want_aux), rtol=1e-6)
+
+
+def test_bf16_compute_dtype():
+    """MoE FFN runs in the compute dtype (routing stays f32)."""
+    router, w1, w2 = (w.astype(jnp.bfloat16)
+                      for w in _moe_weights(jax.random.PRNGKey(6)))
+    x = jax.random.normal(jax.random.PRNGKey(7), (2, 8, D), jnp.bfloat16)
+    out, aux = moe_ffn(x, router, w1, w2, num_experts=E, capacity_factor=4.0)
+    assert out.dtype == jnp.bfloat16
+    assert aux.dtype == jnp.float32
+    ref, _ = moe_ffn(*(v.astype(jnp.float32) for v in (x, router, w1, w2)),
+                     num_experts=E, capacity_factor=4.0)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               atol=0.15, rtol=0.15)
+
+
+def _cfg(n_replicas=1):
+    return base_config(
+        data={"dataset": "synthetic_lm", "batch_size": 4 * n_replicas},
+        model={"name": "transformer", "compute_dtype": "float32",
+               "seq_len": 16, "model_dim": 16, "num_heads": 2,
+               "num_layers": 2, "vocab_size": 31, "attention_impl": "dense",
+               "num_experts": 4, "expert_capacity_factor": 2.0},
+        sync={"mode": "sync", "straggler_profile": "none"},
+    )
+
+
+def _tokens(cfg, key=0):
+    b, s = cfg.data.batch_size, cfg.model.seq_len
+    toks = jax.random.randint(jax.random.PRNGKey(key), (b, s), 0,
+                              cfg.model.vocab_size)
+    return {"image": toks, "label": toks}
+
+
+def _dense_moe_update(cfg, batch):
+    model = get_model(cfg.model)
+    params = model.init(jax.random.PRNGKey(cfg.model.init_seed))
+
+    def loss_fn(p):
+        logits, aux = transformer.apply(
+            p, batch["image"], num_heads=cfg.model.num_heads,
+            compute_dtype=jnp.float32, num_experts=cfg.model.num_experts,
+            capacity_factor=cfg.model.expert_capacity_factor,
+            return_aux=True)
+        return (transformer.loss_fn(logits, batch["label"])
+                + cfg.model.moe_aux_weight * aux)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    return loss, jax.tree.map(lambda p, g: p - LR * g, params, grads)
+
+
+@pytest.mark.parametrize("n_replicas,n_model", [(1, 4), (2, 2)])
+def test_ep_step_matches_dense_update(n_replicas, n_model):
+    cfg = _cfg(n_replicas=n_replicas)
+    batch = _tokens(cfg)
+    want_loss, want_params = _dense_moe_update(cfg, batch)
+
+    topo = make_topology(MeshConfig(num_replicas=n_replicas,
+                                    model_parallelism=n_model))
+    model = get_model(cfg.model)
+    specs = state_partition_specs(model, cfg, topo)
+    state = topo.device_put_state(init_train_state(model, cfg, topo), specs)
+    step_fn = build_train_step(model, cfg, topo, constant(LR))
+    state, metrics = step_fn(state, topo.device_put_batch(batch,
+                                                          seq_sharded=True))
+    np.testing.assert_allclose(float(metrics["loss"]), float(want_loss),
+                               rtol=2e-5, atol=2e-5)
+    got = jax.device_get(state.params)
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-5)
+
+
+def test_moe_sp_combo_rejected():
+    cfg = _cfg()
+    topo = make_topology(MeshConfig(num_replicas=1, model_parallelism=2,
+                                    seq_parallelism=2))
+    with pytest.raises(ValueError, match="sequence parallelism"):
+        build_train_step(get_model(cfg.model), cfg, topo, constant(LR))
+
+
+def test_trainer_end_to_end_ep(tmp_train_dir):
+    from distributedmnist_tpu.train.loop import Trainer
+
+    cfg = _cfg(n_replicas=2)
+    cfg = cfg.override({
+        "mesh.num_replicas": 2, "mesh.model_parallelism": 4,
+        "sync.mode": "quorum", "sync.num_replicas_to_aggregate": 1,
+        "sync.straggler_profile": "lognormal",
+        "train.max_steps": 10, "train.train_dir": tmp_train_dir,
+        "train.log_every_steps": 5, "train.save_interval_secs": 0,
+        "train.save_interval_steps": 5,
+    })
+    tr = Trainer(cfg)
+    summary = tr.run()
+    assert summary["final_step"] == 10
+    ev = tr.evaluate("test")
+    assert np.isfinite(ev["loss"])
