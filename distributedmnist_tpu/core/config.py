@@ -167,7 +167,10 @@ class TrainConfig:
     seed: int = 0
     save_interval_steps: int = 200  # ≙ save_interval_secs=20 Supervisor autosave (:76)
     save_interval_secs: float = 0.0  # optional wall-clock cadence; 0 = step-based
-    log_every_steps: int = 1  # reference logs every step (:365-371)
+    # The reference logs every step (:365-371); here metrics stay on
+    # device and the canonical line flushes on this cadence so the step
+    # loop issues no per-step host fetch at defaults.
+    log_every_steps: int = 10
     save_results_period: int = 1000  # ≙ FLAGS.save_results_period (:56-57)
     summary_every_steps: int = 100  # ≙ save_summaries_secs (:78)
     keep_checkpoints: int = 5

@@ -146,7 +146,10 @@ class PodManager:
         plus whether python is running on any worker."""
         out = self.runner.run(self._base("describe") + ["--format", "json"],
                               capture=True)
-        probe = self.runner.run(self._ssh("pgrep -c python || true"),
+        # [d]… so the pattern never matches the ssh-spawned shell whose
+        # own command line contains it (pgrep -f excludes only itself).
+        probe = self.runner.run(
+            self._ssh("pgrep -c -f '[d]istributedmnist_tpu.launch' || true"),
                                 capture=True, check=False)
         if out is None:  # dry-run: both argvs recorded above, no result
             return None

@@ -90,6 +90,11 @@ class Model:
     # (logits, aux); the train step adds ``aux_weight * aux``.
     has_aux: bool = False
     aux_weight: float = 0.0
+    # True when ``apply(train=True)`` consumes ``dropout_key``. The
+    # SP/PP loss paths do not thread a dropout key (parallel/api.py);
+    # they refuse such a model rather than silently training without
+    # dropout.
+    uses_dropout: bool = False
 
 
 _REGISTRY: dict[str, Callable[[ModelConfig], Model]] = {}
@@ -129,7 +134,8 @@ def _mnist_cnn(cfg: ModelConfig) -> Model:
 
     return Model(name=cfg.name, init=init, apply=apply,
                  loss=cnn.loss_fn, accuracy=cnn.accuracy,
-                 input_shape=(cfg.image_size, cfg.image_size, cfg.num_channels))
+                 input_shape=(cfg.image_size, cfg.image_size, cfg.num_channels),
+                 uses_dropout=cfg.dropout_rate > 0.0)
 
 
 @register("resnet20")

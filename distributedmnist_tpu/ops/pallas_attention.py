@@ -102,8 +102,11 @@ def _forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
              scale: float, block_q: int, block_k: int,
              interpret: bool) -> jax.Array:
     b, h, s, d = q.shape
-    block_q = min(block_q, max(s, 1))
-    block_k = min(block_k, max(s, 1))
+    # Clamp to the sequence, then round up to the 8-row sublane tile so
+    # Mosaic gets aligned BlockSpecs even for s not a multiple of 8; the
+    # lcm padding + seq_len masking below make the overhang safe.
+    block_q = -(-min(block_q, max(s, 1)) // 8) * 8
+    block_k = -(-min(block_k, max(s, 1)) // 8) * 8
 
     import math
 
@@ -199,7 +202,7 @@ def _bwd_blockwise(q, k, v, out, dout, causal: bool, scale: float,
         want = getattr(jax.typeof(q32), "vma", frozenset()) or frozenset()
         have = getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
         missing = tuple(want - have)
-        return lax.pvary(x, missing) if missing else x
+        return lax.pcast(x, missing, to="varying") if missing else x
 
     m0, l0, dq0 = (match_vma(x) for x in (m0, l0, dq0))
     (m, l), _ = lax.scan(lse_step, (m0, l0), jnp.arange(nblk))

@@ -204,6 +204,14 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
     sharded_apply = (model.sharded_apply_factory(
         seq_ax if n_seq > 1 else None, model_ax if n_model > 1 else None)
         if (n_seq > 1 or n_model > 1) else None)
+    # The SP/PP loss paths do not thread a dropout key; refuse loudly
+    # instead of silently training a dropout model without dropout.
+    if ((sharded_apply is not None or pp_apply is not None)
+            and getattr(model, "uses_dropout", False)):
+        raise ValueError(
+            f"model {model.name!r} uses dropout, but the sharded "
+            "(SP/TP/PP) loss paths do not thread a dropout key; set "
+            "model.dropout_rate=0 or run it data-parallel only")
     # raw per-shard grads are needed w.r.t. the axes the masks/explicit
     # psums manage; the model axis stays as-is (sharded params are
     # already device-varying there)

@@ -78,11 +78,14 @@ class AsyncCheckpointer:
     the next ``save``/``wait``.
     """
 
-    def __init__(self):
+    def __init__(self, max_consecutive_failures: int = 3):
         self._lock = threading.Lock()
         self._pending: tuple | None = None
         self._busy = False
         self._error: Exception | None = None  # last write's outcome
+        self._last_failure: Exception | None = None  # never cleared by wait()
+        self._consecutive_failures = 0
+        self.max_consecutive_failures = max_consecutive_failures
         self._wake = threading.Condition(self._lock)
         self._stop = False
         self.closed = False
@@ -109,9 +112,12 @@ class AsyncCheckpointer:
                              job[2], e)
                 with self._lock:
                     self._error = e
+                    self._last_failure = e
+                    self._consecutive_failures += 1
             else:
                 with self._lock:
                     self._error = None  # a later success supersedes
+                    self._consecutive_failures = 0
             finally:
                 with self._wake:
                     self._busy = False
@@ -125,10 +131,19 @@ class AsyncCheckpointer:
 
     def save(self, train_dir: str | Path, state: Any, step: int,
              extra: dict | None = None, keep: int = 5) -> None:
-        """Queue a write. Never raises for an earlier write's failure —
+        """Queue a write. A single failed write never raises here —
         that already went to the log and a later save may well succeed
         (transient disk pressure); ``wait`` raises if the LAST write
-        failed, so a broken final checkpoint is never silent."""
+        failed, so a broken final checkpoint is never silent. A
+        persistently broken disk does stop training: after
+        ``max_consecutive_failures`` failed writes in a row, ``save``
+        raises instead of letting checkpoints go silently stale."""
+        with self._lock:
+            if self._consecutive_failures >= self.max_consecutive_failures:
+                raise RuntimeError(
+                    f"{self._consecutive_failures} consecutive async "
+                    "checkpoint writes failed; giving up"
+                ) from self._last_failure
         host_state = jax.device_get(state)  # sync: buffers get donated next step
         with self._wake:
             if self.closed:

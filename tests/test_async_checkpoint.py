@@ -70,3 +70,18 @@ def test_trainer_async_checkpoint_resume(tmp_train_dir):
     tr2 = Trainer(cfg.override({"train.max_steps": 8}))
     assert tr2._start_step == 6
     assert tr2.run()["final_step"] == 8
+
+
+def test_save_escalates_after_consecutive_failures(tmp_path):
+    # A file where the checkpoint *directory* should be makes every
+    # write fail the same way a persistently broken disk would.
+    blocker = tmp_path / "blocked"
+    blocker.write_text("not a dir")
+    ac = ckpt.AsyncCheckpointer(max_consecutive_failures=3)
+    for step in range(1, 4):
+        ac.save(blocker, _state(), step)
+        with pytest.raises(RuntimeError):
+            ac.wait()  # each failed write surfaces on drain
+    # the 4th save refuses up-front: checkpoints are persistently stale
+    with pytest.raises(RuntimeError, match="consecutive"):
+        ac.save(blocker, _state(), 4)

@@ -118,3 +118,15 @@ def test_trainer_end_to_end_seq_parallel(tmp_train_dir):
     assert summary["last_metrics"]["loss"] < 3.4
     ev = tr.evaluate("test")
     assert ev["num_examples"] == 256
+
+
+def test_sharded_paths_refuse_dropout_models():
+    """A model that consumes a dropout key must not silently train
+    without dropout on the SP path (which does not thread one)."""
+    import dataclasses
+
+    cfg = _cfg("ring", 2, 4)
+    topo = make_topology(MeshConfig(num_replicas=2, seq_parallelism=4))
+    model = dataclasses.replace(get_model(cfg.model), uses_dropout=True)
+    with pytest.raises(ValueError, match="dropout"):
+        build_train_step(model, cfg, topo, constant(LR))
