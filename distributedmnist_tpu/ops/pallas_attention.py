@@ -39,9 +39,13 @@ _NEG_INF = -1e30  # finite: keeps exp() algebra NaN-free on padded rows
 _LANE = 128
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                  scale: float, causal: bool, block_q: int, block_k: int,
-                 seq_len: int):
+                 seq_len: int, save_lse: bool):
+    if save_lse:  # lse output only exists on the VJP-forward variant
+        lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        acc_ref, m_ref, l_ref = rest
     iq = pl.program_id(1)
     ik = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -57,9 +61,12 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(live)
     def _accumulate():
-        q = q_ref[0].astype(jnp.float32)   # [bq, dp]
-        k = k_ref[0].astype(jnp.float32)   # [bk, dp]
-        v = v_ref[0].astype(jnp.float32)   # [bk, dp]
+        # native-dtype operands: bf16 inputs ride the MXU's bf16 path
+        # (4× f32 throughput) with f32 accumulation via
+        # preferred_element_type
+        q = q_ref[0]   # [bq, dp]
+        k = k_ref[0]   # [bk, dp]
+        v = v_ref[0]   # [bk, dp]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         kpos = ik * block_k + jax.lax.broadcasted_iota(
@@ -78,7 +85,7 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         corr = jnp.exp(m_prev - m_new)               # [bq, 1]
         l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[:] = acc_ref[:] * corr + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
@@ -86,6 +93,14 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     def _finalize():
         denom = jnp.maximum(l_ref[:, :1], 1e-30)
         o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        if save_lse:
+            # log-sum-exp per query row, lane-broadcast (the backward
+            # kernels re-normalize scores with it instead of
+            # re-reducing). The 128-lane replication is the TPU-native
+            # layout for a per-sublane-row scalar (the lane dim cannot
+            # go below one 128 tile); upstream flash kernels store TWO
+            # such arrays (l and m) — folding into lse halves that.
+            lse_ref[0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
 
 
 def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
@@ -98,48 +113,82 @@ def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
     return jnp.pad(x, widths)
 
 
-def _forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
-             scale: float, block_q: int, block_k: int,
-             interpret: bool) -> jax.Array:
-    b, h, s, d = q.shape
-    # Clamp to the sequence, then round up to the 8-row sublane tile so
-    # Mosaic gets aligned BlockSpecs even for s not a multiple of 8; the
-    # lcm padding + seq_len masking below make the overhang safe.
-    block_q = -(-min(block_q, max(s, 1)) // 8) * 8
-    block_k = -(-min(block_k, max(s, 1)) // 8) * 8
+def _block_sizes(s: int, block_q: int, block_k: int) -> tuple[int, int]:
+    """Clamp blocks to the sequence and align to the 8-row sublane tile.
 
+    Beyond clamping, blocks are *balanced*: keep the block count implied
+    by the requested size, then shrink each block so the last one isn't
+    mostly padding (s=600 with 512-blocks becomes 2×304 → 608 padded
+    rows instead of 2×512 → 1024, saving ~2.9× of masked-out MXU work).
+    Balancing is discarded if it blows up the lcm padding instead. The
+    backward must derive the SAME values so residual shapes line up.
+    """
     import math
+    r8 = lambda n: -(-n // 8) * 8
+    bq0 = r8(min(block_q, max(s, 1)))
+    bk0 = r8(min(block_k, max(s, 1)))
+    bq1 = r8(-(-s // max(1, -(-s // bq0))))
+    bk1 = r8(-(-s // max(1, -(-s // bk0))))
 
-    def prep(x):
-        x = x.reshape(b * h, s, d)
-        x = _pad_to(x, 2, _LANE)
-        # lcm so BOTH grids tile the padded sequence exactly
-        return _pad_to(x, 1, math.lcm(block_q, block_k))
+    def padded(bq, bk):
+        m = math.lcm(bq, bk)
+        return -(-s // m) * m
 
-    qp, kp, vp = prep(q), prep(k), prep(v)
+    return min(((bq1, bk1), (bq0, bk0)),
+               key=lambda p: (padded(*p), -(p[0] * p[1])))
+
+
+def _prep(x: jax.Array, block_q: int, block_k: int) -> jax.Array:
+    """[b, h, s, d] → [b·h, s_padded, d_padded] (lcm so BOTH grids tile
+    the padded sequence exactly)."""
+    import math
+    b, h, s, d = x.shape
+    x = x.reshape(b * h, s, d)
+    x = _pad_to(x, 2, _LANE)
+    return _pad_to(x, 1, math.lcm(block_q, block_k))
+
+
+def _vma_sds(shape, dtype, *inputs):
+    """ShapeDtypeStruct declaring the union of the inputs' varying mesh
+    axes — required for pallas_call outputs under shard_map check_vma."""
+    vma = frozenset()
+    for x in inputs:
+        vma |= getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+    return (jax.ShapeDtypeStruct(shape, dtype, vma=vma) if vma
+            else jax.ShapeDtypeStruct(shape, dtype))
+
+
+def _forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
+             scale: float, block_q: int, block_k: int, interpret: bool,
+             save_lse: bool) -> tuple[jax.Array, jax.Array | None]:
+    b, h, s, d = q.shape
+    block_q, block_k = _block_sizes(s, block_q, block_k)
+    qp = _prep(q, block_q, block_k)
+    kp = _prep(k, block_q, block_k)
+    vp = _prep(v, block_q, block_k)
     bh, sp, dp = qp.shape
     nq, nk = sp // block_q, sp // block_k
 
     kernel = functools.partial(
         _attn_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, seq_len=s)
-    # Under shard_map (check_vma) the output must declare which mesh
-    # axes it varies over — the union of the inputs' varying axes.
-    vma = frozenset()
-    for x in (qp, kp, vp):
-        vma |= getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
-    out_sds = (jax.ShapeDtypeStruct((bh, sp, dp), q.dtype, vma=vma) if vma
-               else jax.ShapeDtypeStruct((bh, sp, dp), q.dtype))
-    out = pl.pallas_call(
+        block_k=block_k, seq_len=s, save_lse=save_lse)
+    out_shape = [_vma_sds((bh, sp, dp), q.dtype, qp, kp, vp)]
+    out_specs = [pl.BlockSpec((1, block_q, dp),
+                              lambda ib, iq, ik: (ib, iq, 0))]
+    if save_lse:
+        out_shape.append(_vma_sds((bh, sp, _LANE), jnp.float32, qp, kp, vp))
+        out_specs.append(pl.BlockSpec((1, block_q, _LANE),
+                                      lambda ib, iq, ik: (ib, iq, 0)))
+    res = pl.pallas_call(
         kernel,
-        out_shape=out_sds,
+        out_shape=out_shape,
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, dp), lambda ib, iq, ik: (ib, iq, 0)),
             pl.BlockSpec((1, block_k, dp), lambda ib, iq, ik: (ib, ik, 0)),
             pl.BlockSpec((1, block_k, dp), lambda ib, iq, ik: (ib, ik, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, dp), lambda ib, iq, ik: (ib, iq, 0)),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((block_q, dp), jnp.float32),     # acc
             pltpu.VMEM((block_q, _LANE), jnp.float32),  # running max
@@ -149,100 +198,199 @@ def _forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp)
-    return out[:, :s, :d].reshape(b, h, s, d)
+    out = res[0][:, :s, :d].reshape(b, h, s, d)
+    return out, (res[1] if save_lse else None)
 
 
 # ---------------------------------------------------------------------------
-# Backward: flash-style blockwise VJP. The pallas forward isn't
-# auto-differentiable (scratch accumulators), so the gradient is a
-# custom VJP that recomputes scores block-by-block in f32 — residuals
-# stay O(s·d) (q, k, v, out only; the [s, s] score matrix is never
-# materialized). Expressed in jnp/lax.scan so XLA fuses it; a dedicated
-# backward pallas kernel is a later optimization.
+# Backward: FlashAttention-2 style pallas kernels. The forward saves
+# per-row log-sum-exp, so the backward re-derives p = exp(s - lse) in
+# one pass — no second online softmax. Two kernels, both recomputing
+# the score block on the MXU from VMEM-resident tiles:
+#   * dq: grid (bh, q, k) — k innermost, dq accumulates in scratch.
+#   * dk/dv: grid (bh, k, q) — q innermost, so each k/v tile stays
+#     resident while q/do/lse/delta stream past; the transposed
+#     contractions (pᵀ·do, dsᵀ·q) ride the MXU via dot_general instead
+#     of materializing a transpose.
+# Residuals stay O(s·d) + O(s) for lse; the [s, s] score matrix never
+# touches HBM in either direction.
 # ---------------------------------------------------------------------------
 
-def _bwd_blockwise(q, k, v, out, dout, causal: bool, scale: float,
-                   block: int):
+def _scores_block(q_ref, k_ref, lse_ref, iq, ik, *, scale, causal,
+                  block_q, block_k, seq_len):
+    """Recompute p = exp(q·kᵀ·scale − lse) for one [bq, bk] tile.
+
+    Padded rows carry garbage lse (the forward never normalized them),
+    so validity masking must zero p — selection, not arithmetic, keeps
+    the inf/NaN out."""
+    s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    qpos = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    kpos = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = (qpos < seq_len) & (kpos < seq_len)
+    if causal:
+        mask &= qpos >= kpos
+    p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, :1]), 0.0)
+    return p
+
+
+def _delta_block(do_ref, o_ref):
+    """δ_i = rowsum(do ⊙ out) for one q block — recomputed in-kernel
+    from the out residual (a [bq, d] elementwise+reduce, negligible
+    next to the matmuls) instead of materializing a lane-broadcast
+    [s, 128] array in HBM."""
+    return jnp.sum(do_ref[0].astype(jnp.float32)
+                   * o_ref[0].astype(jnp.float32), axis=1, keepdims=True)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                   dq_ref, dq_acc, *, scale: float, causal: bool,
+                   block_q: int, block_k: int, seq_len: int):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _accumulate():
+        p = _scores_block(q_ref, k_ref, lse_ref, iq, ik, scale=scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          seq_len=seq_len)
+        k = k_ref[0]
+        dp = jax.lax.dot_general(do_ref[0], v_ref[0],
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - _delta_block(do_ref, o_ref))
+        dq_acc[:] += jnp.dot(ds.astype(k.dtype), k,
+                             preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                    causal: bool, block_q: int, block_k: int, seq_len: int):
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # Under the causal mask, q blocks strictly before this k block see
+    # none of it.
+    live = (iq * block_q + block_q - 1 >= ik * block_k) if causal else True
+
+    @pl.when(live)
+    def _accumulate():
+        p = _scores_block(q_ref, k_ref, lse_ref, iq, ik, scale=scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          seq_len=seq_len)
+        q = q_ref[0]
+        do = do_ref[0]
+        # contract over the q rows (dim 0 of both): pᵀ·do and dsᵀ·q
+        dv_acc[:] += jax.lax.dot_general(p.astype(do.dtype), do,
+                                         (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - _delta_block(do_ref, o_ref))
+        dk_acc[:] += jax.lax.dot_general(ds.astype(q.dtype), q,
+                                         (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32) * scale
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _backward(q, k, v, out, lse, dout, causal: bool, scale: float,
+              block_q: int, block_k: int, interpret: bool):
     b, h, s, d = q.shape
-    f32 = jnp.float32
-    q32, k32, v32, o32, do32 = (x.astype(f32) for x in (q, k, v, out, dout))
-    kp = _pad_to(k32, 2, block)
-    vp = _pad_to(v32, 2, block)
-    sp = kp.shape[2]
-    nblk = sp // block
-    kpos_base = jnp.arange(block)
-    qpos = jnp.arange(s)[:, None]                       # [s, 1]
-    delta = jnp.sum(do32 * o32, axis=-1, keepdims=True)  # [b,h,s,1]
+    block_q, block_k = _block_sizes(s, block_q, block_k)
+    qp = _prep(q, block_q, block_k)
+    kp = _prep(k, block_q, block_k)
+    vp = _prep(v, block_q, block_k)
+    dop = _prep(dout, block_q, block_k)
+    op = _prep(out, block_q, block_k)
+    bh, sp, dp = qp.shape
+    nq, nk = sp // block_q, sp // block_k
+    assert lse.shape == (bh, sp, _LANE), (lse.shape, (bh, sp, _LANE))
 
-    def scores(jblk):
-        kj = lax.dynamic_slice_in_dim(kp, jblk * block, block, axis=2)
-        sij = jnp.einsum("bhqd,bhkd->bhqk", q32, kj) * scale
-        kpos = jblk * block + kpos_base[None, :]
-        mask = kpos < s
-        if causal:
-            mask = mask & (qpos >= kpos)
-        return jnp.where(mask, sij, _NEG_INF), kj
+    # Per grid: the q-tiled operands follow the q program index — dim 1
+    # in the dq grid (bh, nq, nk), dim 2 in the dkv grid (bh, nk, nq) —
+    # and the k-tiled operands follow the other.
+    qspec = pl.BlockSpec((1, block_q, dp), lambda ib, i, j: (ib, i, 0))
+    lane_q = pl.BlockSpec((1, block_q, _LANE), lambda ib, i, j: (ib, i, 0))
+    qspec_inner = pl.BlockSpec((1, block_q, dp), lambda ib, i, j: (ib, j, 0))
+    lane_q_inner = pl.BlockSpec((1, block_q, _LANE),
+                                lambda ib, i, j: (ib, j, 0))
+    kspec = pl.BlockSpec((1, block_k, dp), lambda ib, i, j: (ib, i, 0))
+    kspec_inner = pl.BlockSpec((1, block_k, dp), lambda ib, i, j: (ib, j, 0))
 
-    # pass 1: log-sum-exp per query row, streaming over k blocks
-    def lse_step(carry, jblk):
-        m, l = carry
-        sij, _ = scores(jblk)
-        m_new = jnp.maximum(m, jnp.max(sij, axis=-1, keepdims=True))
-        l = l * jnp.exp(m - m_new) + jnp.sum(jnp.exp(sij - m_new), -1,
-                                             keepdims=True)
-        return (m_new, l), None
+    common = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, seq_len=s)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        out_shape=_vma_sds((bh, sp, dp), q.dtype, qp, kp, vp, dop),
+        grid=(bh, nq, nk),
+        in_specs=[qspec, kspec_inner, kspec_inner, qspec, qspec, lane_q],
+        out_specs=qspec,
+        scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp, dop, op, lse)
 
-    m0 = jnp.full((b, h, s, 1), _NEG_INF, f32)
-    l0 = jnp.zeros((b, h, s, 1), f32)
-    dq0 = jnp.zeros_like(q32)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        out_shape=[_vma_sds((bh, sp, dp), k.dtype, qp, kp, vp, dop),
+                   _vma_sds((bh, sp, dp), v.dtype, qp, kp, vp, dop)],
+        grid=(bh, nk, nq),
+        in_specs=[qspec_inner, kspec, kspec, qspec_inner, qspec_inner,
+                  lane_q_inner],
+        out_specs=[kspec, kspec],
+        scratch_shapes=[pltpu.VMEM((block_k, dp), jnp.float32),
+                        pltpu.VMEM((block_k, dp), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp, dop, op, lse)
 
-    # Under shard_map, scan carries must match the loop outputs' varying
-    # axes (which inherit from the sharded q/k/v).
-    def match_vma(x):
-        want = getattr(jax.typeof(q32), "vma", frozenset()) or frozenset()
-        have = getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
-        missing = tuple(want - have)
-        return lax.pcast(x, missing, to="varying") if missing else x
+    def unpad(x, dtype):
+        return x[:, :s, :d].reshape(b, h, s, d).astype(dtype)
 
-    m0, l0, dq0 = (match_vma(x) for x in (m0, l0, dq0))
-    (m, l), _ = lax.scan(lse_step, (m0, l0), jnp.arange(nblk))
-    lse = m + jnp.log(jnp.maximum(l, 1e-30))
-
-    # pass 2: dq accumulates across blocks; dk/dv are per-block
-    def bwd_step(dq, jblk):
-        sij, kj = scores(jblk)
-        vj = lax.dynamic_slice_in_dim(vp, jblk * block, block, axis=2)
-        p = jnp.exp(sij - lse)                            # masked → 0
-        dp = jnp.einsum("bhqd,bhkd->bhqk", do32, vj)
-        ds = p * (dp - delta)
-        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kj) * scale
-        dkj = jnp.einsum("bhqk,bhqd->bhkd", ds, q32) * scale
-        dvj = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
-        return dq, (dkj, dvj)
-
-    dq, (dk_blocks, dv_blocks) = lax.scan(bwd_step, dq0, jnp.arange(nblk))
-
-    def unblock(blocks):  # [nblk, b, h, block, d] → [b, h, s, d]
-        x = jnp.moveaxis(blocks, 0, 2)          # [b, h, nblk, block, d]
-        return x.reshape(b, h, sp, d)[:, :, :s]
-
-    return (dq.astype(q.dtype), unblock(dk_blocks).astype(k.dtype),
-            unblock(dv_blocks).astype(v.dtype))
+    return unpad(dq, q.dtype), unpad(dk, k.dtype), unpad(dv, v.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return _forward(q, k, v, causal, scale, block_q, block_k, interpret,
+                    save_lse=False)[0]
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _forward(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v, out)
+    out, lse = _forward(q, k, v, causal, scale, block_q, block_k, interpret,
+                        save_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, dout):
-    q, k, v, out = res
-    return _bwd_blockwise(q, k, v, out, dout, causal, scale, block_k)
+    q, k, v, out, lse = res
+    return _backward(q, k, v, out, lse, dout, causal, scale, block_q,
+                     block_k, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -252,7 +400,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
                                              "block_k", "interpret"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, scale: float | None = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 512, block_k: int = 512,
                     interpret: bool | None = None) -> jax.Array:
     """Exact attention, flash-style. q/k/v: [batch, heads, seq, head_dim]
     (self-attention: one shared seq length). Returns q-shaped output.

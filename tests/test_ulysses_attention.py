@@ -51,14 +51,19 @@ def test_flash_inner_kernel():
                                rtol=2e-5, atol=2e-5)
 
 
-def test_grads_match_oracle():
+@pytest.mark.parametrize("inner", [None, flash_attention],
+                         ids=["dense", "flash"])
+def test_grads_match_oracle(inner):
+    """With inner=flash this differentiates the pallas backward kernels
+    THROUGH shard_map — the flagship Ulysses+flash composition — so the
+    kernels' vma declarations are locked in by CI."""
     q, k, v = _qkv(jax.random.PRNGKey(2))
 
     def obj_local(qkv):
         return jnp.sum(local_self_attention(*qkv, causal=True) ** 2)
 
     def obj_ulysses(qkv):
-        return jnp.sum(_run(*qkv, True) ** 2)
+        return jnp.sum(_run(*qkv, True, attention_fn=inner) ** 2)
 
     g_l = jax.grad(obj_local)((q, k, v))
     g_u = jax.grad(obj_ulysses)((q, k, v))
