@@ -42,13 +42,32 @@ def initialize_distributed() -> None:
     if _dist.global_state.client is not None:
         return  # already initialized
     hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    explicit = os.environ.get("JAX_COORDINATOR_ADDRESS")
     multi_host_hint = (
-        os.environ.get("JAX_COORDINATOR_ADDRESS")
+        explicit
         or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
         or len([h for h in hostnames.split(",") if h]) > 1)
     if not multi_host_hint:
         return  # single-process run (one chip / CPU simulation)
-    jax.distributed.initialize()
+    if explicit and ("JAX_NUM_PROCESSES" in os.environ
+                     or "JAX_PROCESS_ID" in os.environ):
+        # Generic-cluster bring-up (≙ the reference's explicit
+        # ps_hosts/worker_hosts + task_index flags,
+        # src/mnist_distributed_train.py:25-31): jax's auto-detection
+        # only covers TPU-metadata / SLURM / MPI environments, so a
+        # plain N-process launch names its coordinator explicitly.
+        missing = [v for v in ("JAX_NUM_PROCESSES", "JAX_PROCESS_ID")
+                   if v not in os.environ]
+        if missing:
+            raise RuntimeError(
+                "explicit multi-process launch needs JAX_COORDINATOR_ADDRESS, "
+                f"JAX_NUM_PROCESSES and JAX_PROCESS_ID; missing: {missing}")
+        jax.distributed.initialize(
+            coordinator_address=explicit,
+            num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+            process_id=int(os.environ["JAX_PROCESS_ID"]))
+    else:
+        jax.distributed.initialize()
 
 
 def simulate_devices(n: int) -> None:

@@ -1,0 +1,148 @@
+"""Live multi-host execution: two real ``jax.distributed`` processes
+(4 virtual CPU devices each → one 8-device global mesh) training and
+evaluating through the full product stack, asserted for loss/param
+parity against the single-process 8-device run.
+
+This is the one reference capability — an actually-running
+multi-process cluster (reference src/mnist_distributed_train.py:25-35)
+— that unit tests cannot cover in-process: ``jax.distributed``
+bring-up (core/mesh.initialize_distributed), per-process batch
+assembly (``make_array_from_process_local_data`` in
+Topology.device_put_batch), host-sharded ingest (data/pipeline
+``shard_mode="sharded"``) and the striped multi-host eval with its
+process allgather (train/evaluation.run_full_eval).
+
+Parity argument: the dataset equals the global batch (full-batch
+steps), so the multiset of rows per step is identical however the
+hosts shard it; with equal per-replica row counts the replica-mean of
+means equals the global mean, making losses and SGD updates equal up
+to float reassociation.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from conftest import base_config
+
+_CHILD = """
+import json, os, sys
+from distributedmnist_tpu.core.mesh import initialize_distributed, simulate_devices
+simulate_devices(4)           # per-process local devices
+initialize_distributed()      # before any backend touch
+import jax
+import numpy as np
+from distributedmnist_tpu.core.config import ExperimentConfig
+from distributedmnist_tpu.train.loop import Trainer
+
+cfg = ExperimentConfig.from_dict(json.loads(os.environ["DML_CFG"]))
+t = Trainer(cfg)
+summary = t.run()
+ev = t.evaluate()
+leaves = jax.tree.leaves(jax.device_get(t.state.params))
+print("RESULT " + json.dumps({
+    "process_count": jax.process_count(),
+    "local_devices": jax.local_device_count(),
+    "global_devices": len(jax.devices()),
+    "final_step": summary["final_step"],
+    "loss": summary["last_metrics"]["loss"],
+    "param_l1": float(sum(np.abs(np.asarray(x), dtype=np.float64).sum()
+                          for x in leaves)),
+    "eval_accuracy": ev["accuracy"],
+    "eval_loss": ev["loss"],
+    "eval_num_examples": ev["num_examples"],
+}))
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _cfg_dict(train_dir: str) -> dict:
+    # Full-batch (dataset == global batch) for the parity argument
+    # above; dropout off because dropout masks are keyed by replica
+    # and rows land on different replicas across launch shapes.
+    return {
+        "data": {"dataset": "synthetic", "batch_size": 128,
+                 "synthetic_train_size": 128, "synthetic_test_size": 96,
+                 "use_native_pipeline": False},
+        "model": {"compute_dtype": "float32", "dropout_rate": 0.0},
+        "optim": {"learning_rate_decay_factor": 1.0},
+        "sync": {"mode": "sync", "straggler_profile": "none"},
+        "eval": {"eval_batch_size": 32},
+        "train": {"max_steps": 4, "log_every_steps": 2,
+                  "save_interval_steps": 0, "save_results_period": 0,
+                  "train_dir": train_dir},
+    }
+
+
+def _launch(tmp_path):
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["JAX_NUM_PROCESSES"] = "2"
+        env["JAX_PROCESS_ID"] = str(pid)
+        env["DML_CFG"] = json.dumps(
+            _cfg_dict(str(tmp_path / f"multihost_p{pid}")))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _CHILD], env=env, cwd=os.getcwd(),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    results = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=600)
+            assert p.returncode == 0, f"child failed:\n{err[-4000:]}"
+            line = [ln for ln in out.splitlines()
+                    if ln.startswith("RESULT ")]
+            assert line, f"no RESULT line:\n{out[-2000:]}\n{err[-2000:]}"
+            results.append(json.loads(line[-1][len("RESULT "):]))
+    finally:
+        for q in procs:  # a failed sibling must not orphan the other
+            if q.poll() is None:
+                q.kill()
+    return results
+
+
+def test_two_process_training_matches_single_process(tmp_path):
+    r0, r1 = _launch(tmp_path)
+    for r in (r0, r1):
+        assert r["process_count"] == 2
+        assert r["local_devices"] == 4
+        assert r["global_devices"] == 8
+        assert r["final_step"] == 4
+    # both processes observe the same global state
+    np.testing.assert_allclose(r0["loss"], r1["loss"], rtol=1e-6)
+    np.testing.assert_allclose(r0["param_l1"], r1["param_l1"], rtol=1e-6)
+    assert r0["eval_num_examples"] == r1["eval_num_examples"] == 96
+
+    # single-process 8-device reference run, identical config
+    from distributedmnist_tpu.train.loop import Trainer
+    import jax
+    cfg = base_config(**_cfg_dict(str(tmp_path / "single")))
+    t = Trainer(cfg)
+    summary = t.run()
+    ev = t.evaluate()
+    leaves = jax.tree.leaves(jax.device_get(t.state.params))
+    param_l1 = float(sum(np.abs(np.asarray(x), dtype=np.float64).sum()
+                         for x in leaves))
+
+    np.testing.assert_allclose(r0["loss"], summary["last_metrics"]["loss"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(r0["param_l1"], param_l1, rtol=1e-6)
+    np.testing.assert_allclose(r0["eval_loss"], ev["loss"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(r0["eval_accuracy"], ev["accuracy"],
+                               rtol=1e-5, atol=1e-6)
+    assert ev["num_examples"] == 96
