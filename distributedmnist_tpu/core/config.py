@@ -46,6 +46,10 @@ class DataConfig:
     synthetic_test_size: int = 2048
     use_native_pipeline: bool = True  # C++ prefetch loader when built
     prefetch_batches: int = 2
+    # Fetch missing idx files into data_dir before loading
+    # (≙ maybe_download, src/mnist_data.py:176-187). Degrades to the
+    # synthetic fallback when there is no network egress.
+    download: bool = True
 
 
 @dataclass(frozen=True)

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import gzip
+import os
 import pickle
 import struct
 from pathlib import Path
@@ -117,12 +118,104 @@ def read_idx_labels(path: Path) -> np.ndarray:
     return _read_idx_ubyte(path, 1).astype(np.int32)
 
 
+def write_idx_ubyte(path: Path, arr: np.ndarray) -> Path:
+    """Write a uint8 array as an idx(.gz) file — the exact inverse of
+    ``_read_idx_ubyte``. Used by tests (round-trip fixtures) and as a
+    dataset snapshot tool; gzip when the suffix is ``.gz``."""
+    arr = np.ascontiguousarray(arr, dtype=np.uint8)
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    header = struct.pack(">HBB", 0, 0x08, arr.ndim)
+    header += struct.pack(f">{arr.ndim}I", *arr.shape)
+    opener = gzip.open if path.suffix == ".gz" else open
+    with opener(path, "wb") as f:
+        f.write(header)
+        f.write(arr.tobytes())
+    return path
+
+
 _IDX_FILES = {
     "train_images": ["train-images-idx3-ubyte", "train-images.idx3-ubyte"],
     "train_labels": ["train-labels-idx1-ubyte", "train-labels.idx1-ubyte"],
     "test_images": ["t10k-images-idx3-ubyte", "t10k-images.idx3-ubyte"],
     "test_labels": ["t10k-labels-idx1-ubyte", "t10k-labels.idx1-ubyte"],
 }
+
+# Public mirrors for the canonical idx archives. Fashion-MNIST ships
+# the same four file names.
+_IDX_MIRRORS = {
+    "mnist": [
+        "https://storage.googleapis.com/cvdf-datasets/mnist/",
+        "https://ossci-datasets.s3.amazonaws.com/mnist/",
+    ],
+    "fashion_mnist": [
+        "http://fashion-mnist.s3-website.eu-central-1.amazonaws.com/",
+    ],
+}
+
+
+def maybe_download(data_dir: str | Path, dataset: str = "mnist",
+                   timeout: float = 30.0,
+                   expected_sha256: dict[str, str] | None = None) -> bool:
+    """Fetch any missing idx.gz files into ``data_dir`` with caching
+    (≙ maybe_download, src/mnist_data.py:176-187 — which pulled from
+    the Yann LeCun host; mirrors here because that host now throttles).
+
+    Returns True when all four files are present afterwards. Network
+    failure is not an error — the caller falls back to synthetic data —
+    but a file that downloads with a corrupt idx payload is deleted and
+    reported so a truncated fetch can't poison the cache. Pass
+    ``expected_sha256`` ({file name → hex digest}) to pin archives
+    cryptographically — the structural idx validation alone cannot
+    reject a well-formed substitute served by a hostile network.
+
+    Concurrency-safe for shared data dirs (e.g. every process of a
+    multi-host launch downloading at once): each writer stages to a
+    pid-unique temp file and installs with an atomic rename.
+    """
+    from ..core.log import get_logger
+    logger = get_logger("data")
+    root = Path(data_dir)
+    mirrors = _IDX_MIRRORS.get(dataset)
+    if mirrors is None:
+        return False
+    root.mkdir(parents=True, exist_ok=True)
+    ok = True
+    for key, names in _IDX_FILES.items():
+        if _find_idx(root, names) is not None:
+            continue  # cached
+        fname = names[0] + ".gz"
+        fetched = False
+        for base in mirrors:
+            url = base + fname
+            # gz suffix kept so the validator opens the staged file
+            # through gzip; pid-unique stem avoids cross-process races
+            tmp = root / f".{os.getpid()}.part.{fname}"
+            final = root / fname
+            try:
+                import urllib.request
+                with urllib.request.urlopen(url, timeout=timeout) as r, \
+                        open(tmp, "wb") as f:
+                    f.write(r.read())
+                if expected_sha256 and fname in expected_sha256:
+                    import hashlib
+                    got = hashlib.sha256(tmp.read_bytes()).hexdigest()
+                    if got != expected_sha256[fname]:
+                        raise ValueError(
+                            f"sha256 mismatch for {fname}: {got}")
+                # full structural parse → truncated/corrupt payloads out
+                _read_idx_ubyte(tmp, 3 if "images" in key else 1)
+                tmp.rename(final)  # atomic install
+            except Exception as e:  # no egress / mirror down / corrupt
+                tmp.unlink(missing_ok=True)
+                logger.warning("could not fetch %s: %s", url, e)
+                continue
+            logger.info("downloaded %s from %s", fname, base)
+            fetched = True
+            break
+        # another process may have installed it while we failed
+        ok &= fetched or _find_idx(root, names) is not None
+    return ok
 
 
 def _find_idx(root: Path, names: list[str]) -> Path | None:
@@ -271,8 +364,16 @@ def load_datasets(cfg: DataConfig, image_size: int = 28, num_channels: int = 1,
     name = cfg.dataset
     try:
         if name in ("mnist", "fashion_mnist"):
+            # hand-placed flat files still load; downloads always land
+            # in a per-dataset subdir (mnist and fashion_mnist share
+            # file names — a flat cache would silently cross-serve)
             sub = Path(cfg.data_dir) / name
             root = sub if sub.exists() else Path(cfg.data_dir)
+            if (cfg.download
+                    and any(_find_idx(root, v) is None
+                            for v in _IDX_FILES.values())):
+                maybe_download(sub, name)
+                root = sub
             return load_idx_dataset(root)
         if name == "cifar10":
             return load_cifar10(cfg.data_dir)
