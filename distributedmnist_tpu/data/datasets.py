@@ -153,6 +153,28 @@ _IDX_MIRRORS = {
     ],
 }
 
+# Pinned sha256 digests of the canonical MNIST .gz archives (as
+# published across OSS dataset tooling) — passed by default so the
+# default download path rejects a well-formed substitute served by a
+# hostile mirror, not just a corrupt one. A mismatch is handled like
+# any fetch failure: the file is discarded and the next mirror (or the
+# synthetic fallback) takes over, so a stale pin can never hard-break
+# ingest. Fashion-MNIST publishes md5s, not sha256s, in its README —
+# no offline-verifiable sha256 exists here, so it stays unpinned
+# (structural idx validation still applies).
+_PINNED_SHA256 = {
+    "mnist": {
+        "train-images-idx3-ubyte.gz":
+            "440fcabf73cc546fa21475e81ea370265605f56be210a4024d2ca8f203523609",
+        "train-labels-idx1-ubyte.gz":
+            "3552534a0a558bbed6aed32b30c495cca23d567ec52cac8be1a0730e8010255c",
+        "t10k-images-idx3-ubyte.gz":
+            "8d422c7b0a1c1c79245a5bcf07fe86e33eeafee792b84584aec276f5a2dbc4e6",
+        "t10k-labels-idx1-ubyte.gz":
+            "f7ae60f92e00ec6debd23a6088c31dbd2371eca3ffa0defaefb259924204aec6",
+    },
+}
+
 
 def maybe_download(data_dir: str | Path, dataset: str = "mnist",
                    timeout: float = 30.0,
@@ -167,7 +189,9 @@ def maybe_download(data_dir: str | Path, dataset: str = "mnist",
     reported so a truncated fetch can't poison the cache. Pass
     ``expected_sha256`` ({file name → hex digest}) to pin archives
     cryptographically — the structural idx validation alone cannot
-    reject a well-formed substitute served by a hostile network.
+    reject a well-formed substitute served by a hostile network. When
+    omitted, the per-dataset ``_PINNED_SHA256`` pins apply by default;
+    pass ``{}`` explicitly to disable pinning.
 
     Concurrency-safe for shared data dirs (e.g. every process of a
     multi-host launch downloading at once): each writer stages to a
@@ -179,6 +203,8 @@ def maybe_download(data_dir: str | Path, dataset: str = "mnist",
     mirrors = _IDX_MIRRORS.get(dataset)
     if mirrors is None:
         return False
+    if expected_sha256 is None:
+        expected_sha256 = _PINNED_SHA256.get(dataset, {})
     root.mkdir(parents=True, exist_ok=True)
     ok = True
     for key, names in _IDX_FILES.items():

@@ -1,0 +1,83 @@
+"""Materialized idx-format dataset fixtures.
+
+This environment has no network egress, so the canonical MNIST /
+Fashion-MNIST archives (≙ maybe_download, src/mnist_data.py:176-187)
+cannot be fetched. To still exercise the REAL ingest pipeline —
+idx(.gz) parse → [-0.5, 0.5] normalization → host sharding → training →
+evaluator oracle — this module writes the deterministic learnable
+synthetic dataset (datasets.make_synthetic) to disk in the exact idx
+ubyte format the reference downloads, via ``write_idx_ubyte`` (the
+inverse of the parser, so the bytes round-trip bit-exactly).
+
+The fixture is clearly labeled on disk (PROVENANCE.md): it is NOT the
+real MNIST pixels — it is a stand-in with the same file format, shapes,
+dtype, value range and split sizes, generated from a fixed seed.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from .datasets import (_IDX_FILES, _open_maybe_gz, make_synthetic,
+                       write_idx_ubyte)
+
+# Per-dataset generation seeds: distinct data for mnist/fashion_mnist.
+_FIXTURE_SEEDS = {"mnist": 12345, "fashion_mnist": 54321}
+
+
+def _idx_dims(path: Path) -> tuple[int, ...]:
+    """Read just the idx header (16 bytes max) — shape check without
+    decompressing the payload."""
+    import struct
+    with _open_maybe_gz(path) as f:
+        magic = struct.unpack(">HBB", f.read(4))
+        if magic[0] != 0 or magic[1] != 0x08:
+            return ()
+        return struct.unpack(f">{magic[2]}I", f.read(4 * magic[2]))
+
+
+def materialize_idx_fixture(data_dir: str | Path, dataset: str = "mnist",
+                            num_train: int = 60000, num_test: int = 10000,
+                            image_size: int = 28, noise: float = 0.08,
+                            gzip_files: bool = True) -> Path:
+    """Write a full 4-file idx dataset under ``data_dir`` (idempotent:
+    returns immediately when all four files exist). Shapes/sizes match
+    the real archives: train-images [60000,28,28], t10k [10000,28,28].
+    """
+    root = Path(data_dir)
+    suffix = ".gz" if gzip_files else ""
+    paths = {k: root / (names[0] + suffix) for k, names in _IDX_FILES.items()}
+    want = {"train_images": (num_train, image_size, image_size),
+            "train_labels": (num_train,),
+            "test_images": (num_test, image_size, image_size),
+            "test_labels": (num_test,)}
+    if all(p.exists() for p in paths.values()):
+        # idempotent only when the cached shapes match the request — a
+        # quick-run cache must not silently serve a later full run
+        if all(_idx_dims(paths[k]) == want[k] for k in paths):
+            return root
+    seed = _FIXTURE_SEEDS.get(dataset, 12345)
+    ds = make_synthetic(num_train, num_test, image_size=image_size,
+                        num_channels=1, seed=seed, noise=noise)
+
+    def to_u8(images: np.ndarray) -> np.ndarray:
+        # exact inverse of the loader's (u8 - 127.5)/255 normalization
+        return np.clip(np.round((images[..., 0] + 0.5) * 255.0),
+                       0, 255).astype(np.uint8)
+
+    # the loader carves its own validation slice out of the train file
+    # (load_idx_dataset), exactly as it would from the real archive
+    write_idx_ubyte(paths["train_images"], to_u8(ds.train.images))
+    write_idx_ubyte(paths["train_labels"], ds.train.labels.astype(np.uint8))
+    write_idx_ubyte(paths["test_images"], to_u8(ds.test.images))
+    write_idx_ubyte(paths["test_labels"], ds.test.labels.astype(np.uint8))
+    (root / "PROVENANCE.md").write_text(
+        f"# Fixture dataset ({dataset})\n\n"
+        "Deterministic synthetic data materialized in idx ubyte format "
+        "(distributedmnist_tpu.data.fixtures) because this environment "
+        f"has no network egress. seed={seed}, "
+        f"{num_train} train / {num_test} test. NOT the real archives — "
+        "same format, shapes, dtype and split sizes.\n")
+    return root

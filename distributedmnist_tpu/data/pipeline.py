@@ -132,10 +132,21 @@ def eval_batches(data: ArrayDataset, batch_size: int, pad_multiple: int = 1,
 
 def make_train_iterator(data: ArrayDataset, cfg: DataConfig, seed: int,
                         host_id: int = 0, num_hosts: int = 1) -> BatchIterator:
+    import os
+
     it = BatchIterator(data, cfg.batch_size, seed=seed, host_id=host_id,
                        num_hosts=num_hosts, shard_mode=cfg.shard_mode)
     if cfg.use_native_pipeline:
         from ..core.log import get_logger
+        if (os.cpu_count() or 1) < 2:
+            # a prefetch thread can only fight the consumer for the one
+            # core (measured as a net slowdown by bench_native_loader);
+            # prefetching pays off when it overlaps with device compute
+            # on a spare core
+            get_logger("data").info(
+                "single-core host: skipping the prefetch thread, "
+                "using inline batching")
+            return it
         try:
             from .native_loader import NativePrefetcher
         except ImportError as e:

@@ -106,6 +106,15 @@ def test_maybe_download_purges_corrupt_files(tmp_path, monkeypatch):
     assert not list(tmp_path.glob("*")), "corrupt downloads must be purged"
 
 
+def _pin_fixture_digests(monkeypatch, payloads):
+    """Point the default mnist pins at the test fixture's payloads (the
+    real pins would — correctly — reject fixture bytes)."""
+    import hashlib
+    monkeypatch.setattr(ds, "_PINNED_SHA256", {"mnist": {
+        name: hashlib.sha256(data).hexdigest()
+        for name, data in payloads.items()}})
+
+
 def test_maybe_download_fetches_and_caches(tmp_path, monkeypatch):
     import urllib.request
     arrays = _fixture_arrays()
@@ -118,6 +127,7 @@ def test_maybe_download_fetches_and_caches(tmp_path, monkeypatch):
         return _FakeResponse(payloads[url.rsplit("/", 1)[1]])
 
     monkeypatch.setattr(urllib.request, "urlopen", serve)
+    _pin_fixture_digests(monkeypatch, payloads)
     assert ds.maybe_download(tmp_path, "mnist") is True
     assert len(calls) == 4
     # cache hit: nothing re-fetched
@@ -140,6 +150,7 @@ def test_load_datasets_downloads_when_missing(tmp_path, monkeypatch):
     monkeypatch.setattr(
         urllib.request, "urlopen",
         lambda url, timeout=None: _FakeResponse(payloads[url.rsplit("/", 1)[1]]))
+    _pin_fixture_digests(monkeypatch, payloads)
     cfg = DataConfig(dataset="mnist", data_dir=str(tmp_path))
     d = ds.load_datasets(cfg)
     assert d.test.num_examples == 16  # real data, not the synthetic fallback
@@ -155,6 +166,7 @@ def test_download_lands_in_per_dataset_subdir(tmp_path, monkeypatch):
     monkeypatch.setattr(
         urllib.request, "urlopen",
         lambda url, timeout=None: _FakeResponse(payloads[url.rsplit("/", 1)[1]]))
+    _pin_fixture_digests(monkeypatch, payloads)
     cfg = DataConfig(dataset="mnist", data_dir=str(tmp_path))
     ds.load_datasets(cfg)
     assert (tmp_path / "mnist" / "train-images-idx3-ubyte.gz").exists()
@@ -174,3 +186,39 @@ def test_checksum_mismatch_rejected(tmp_path, monkeypatch):
     bad = {ds._IDX_FILES[k][0] + ".gz": "0" * 64 for k in ds._IDX_FILES}
     assert ds.maybe_download(tmp_path, "mnist", expected_sha256=bad) is False
     assert not list(tmp_path.glob("*ubyte*"))
+
+
+def test_default_pins_reject_substituted_archive(tmp_path, monkeypatch):
+    """The shipped sha256 pins apply BY DEFAULT: a well-formed idx
+    archive with the wrong bytes (hostile-mirror substitution) is
+    rejected without any caller opting in — and an explicit
+    expected_sha256={} disables pinning."""
+    import urllib.request
+    arrays = _fixture_arrays()
+    payloads = {ds._IDX_FILES[k][0] + ".gz": _gz_idx_payload(v)
+                for k, v in arrays.items()}
+    monkeypatch.setattr(
+        urllib.request, "urlopen",
+        lambda url, timeout=None: _FakeResponse(payloads[url.rsplit("/", 1)[1]]))
+    # structurally valid substitute + real pins → rejected, nothing lands
+    assert ds.maybe_download(tmp_path, "mnist") is False
+    assert not list(tmp_path.glob("*ubyte*"))
+    # explicit opt-out accepts the same bytes
+    assert ds.maybe_download(tmp_path, "mnist", expected_sha256={}) is True
+
+
+def test_materialize_idx_fixture_roundtrip(tmp_path):
+    """The campaign's materialized fixture is a REAL idx dataset: the
+    standard loader parses it, values land in [-0.5, 0.5], splits have
+    archive-standard sizes (scaled), and generation is idempotent."""
+    from distributedmnist_tpu.data.fixtures import materialize_idx_fixture
+    root = materialize_idx_fixture(tmp_path, "mnist", num_train=256,
+                                   num_test=64)
+    d = ds.load_idx_dataset(root, validation_size=32)
+    assert d.train.num_examples == 256 - 25  # loader carves min(32, 256//10)
+    assert d.test.num_examples == 64
+    assert -0.5 <= d.train.images.min() and d.train.images.max() <= 0.5
+    assert set(np.unique(d.train.labels)) <= set(range(10))
+    before = (root / "train-images-idx3-ubyte.gz").stat().st_mtime
+    materialize_idx_fixture(tmp_path, "mnist", num_train=256, num_test=64)
+    assert (root / "train-images-idx3-ubyte.gz").stat().st_mtime == before

@@ -158,11 +158,15 @@ def test_lm_shaped_labels():
     pf.close()
 
 
-def test_trainer_end_to_end_with_native_pipeline(tmp_train_dir):
+def test_trainer_end_to_end_with_native_pipeline(tmp_train_dir, monkeypatch):
     """Full Trainer loop fed by the C++ prefetcher, including the
     data-cursor checkpoint round-trip through train.checkpoint."""
+    import os
+
     from conftest import base_config
     from distributedmnist_tpu.train.loop import Trainer
+
+    monkeypatch.setattr(os, "cpu_count", lambda: 4)  # defeat 1-core gate
 
     cfg = base_config(
         data={"use_native_pipeline": True},
@@ -181,9 +185,12 @@ def test_trainer_end_to_end_with_native_pipeline(tmp_train_dir):
     assert tr2.run()["final_step"] == 8
 
 
-def test_make_train_iterator_uses_native():
+def test_make_train_iterator_uses_native(monkeypatch):
+    import os
+
     from distributedmnist_tpu.core.config import DataConfig
     from distributedmnist_tpu.data.pipeline import make_train_iterator
+    monkeypatch.setattr(os, "cpu_count", lambda: 4)  # defeat 1-core gate
     ds = _make_dataset()
     it = make_train_iterator(ds, DataConfig(batch_size=8,
                                             use_native_pipeline=True), seed=0)
@@ -191,3 +198,18 @@ def test_make_train_iterator_uses_native():
     batch = next(it)
     assert batch["image"].shape == (8, 3, 3, 1)
     it.close()
+
+
+def test_make_train_iterator_single_core_skips_prefetch_thread(monkeypatch):
+    """On a 1-core host the prefetch thread only fights the consumer
+    (measured net slowdown) — the pipeline must fall back inline."""
+    import os
+
+    from distributedmnist_tpu.core.config import DataConfig
+    from distributedmnist_tpu.data.pipeline import (BatchIterator,
+                                                    make_train_iterator)
+    monkeypatch.setattr(os, "cpu_count", lambda: 1)
+    ds = _make_dataset()
+    it = make_train_iterator(ds, DataConfig(batch_size=8,
+                                            use_native_pipeline=True), seed=0)
+    assert isinstance(it, BatchIterator)
