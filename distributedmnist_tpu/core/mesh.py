@@ -153,6 +153,53 @@ class Topology:
                 batch)
         return jax.device_put(batch, sharding)
 
+    @property
+    def measured_timing_supported(self) -> bool:
+        """Per-host measured timing is well-defined only when every
+        replica lives wholly on one process (replicas split evenly
+        across processes). E.g. cross-host TP with num_replicas=1 on 2
+        processes has no owner whose measurement could fill the row —
+        and two hosts writing different values into a replicated array
+        would silently diverge its shards."""
+        return (self.num_replicas % jax.process_count() == 0
+                and self.num_replicas >= jax.process_count())
+
+    @property
+    def local_replica_count(self) -> int:
+        """Replicas whose shards this process owns (even split)."""
+        return self.num_replicas // jax.process_count()
+
+    def zeros_measured(self) -> jax.Array:
+        """The all-zeros measured vector [n] — valid on ANY mesh shape
+        (zeros are identical whoever materializes them)."""
+        n = self.num_replicas
+        sharding = NamedSharding(self.mesh, P(self.replica_axis))
+        return jax.make_array_from_callback(
+            (n,), sharding, lambda idx: np.zeros(n, np.float32)[idx])
+
+    def device_put_measured(self, local_ms) -> jax.Array:
+        """Assemble the per-replica measured-step-time vector [n] from
+        this process's local entries (shape [local_replica_count]).
+
+        Each host contributes only the rows for its own replicas — the
+        real per-host measurement — giving the policies a genuinely
+        per-replica time base (≙ the per-worker timing tables the
+        reference gossips over RPC, src/timeout_manager.py:48-61)."""
+        if not self.measured_timing_supported:
+            raise ValueError(
+                f"per-host measured timing needs num_replicas "
+                f"({self.num_replicas}) to split evenly over "
+                f"{jax.process_count()} processes")
+        local = np.asarray(local_ms, np.float32)
+        if local.shape != (self.local_replica_count,):
+            raise ValueError(
+                f"measured vector must be [{self.local_replica_count}] "
+                f"(local replicas), got {local.shape}")
+        sharding = NamedSharding(self.mesh, P(self.replica_axis))
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(sharding, local)
+        return jax.device_put(local, sharding)
+
     def device_put_replicated(self, tree):
         return jax.device_put(tree, self.replicated)
 

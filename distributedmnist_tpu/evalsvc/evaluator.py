@@ -54,6 +54,7 @@ class Evaluator:
         self.template = init_train_state(self.model, cfg, self.topo)
         self.last_step_evaluated = -1
         self._sink: JsonlSink | None = None
+        self._tb = None
 
     def _config_from_checkpoint(self) -> ExperimentConfig:
         """Wait for the first checkpoint, then adopt its saved config.
@@ -112,6 +113,12 @@ class Evaluator:
                         result["loss"], result["seconds"]), flush=True)
         if self._sink:
             self._sink.write(result)
+        if self._tb is not None:
+            # ≙ the evaluator's TB scalars (src/nn_eval.py:107-110)
+            self._tb.add_scalars({"Validation Accuracy": out["accuracy"],
+                                  "Validation Loss": out["loss"]},
+                                 step=at_step)
+            self._tb.flush()
         return result
 
     def run(self) -> list[dict]:
@@ -120,6 +127,8 @@ class Evaluator:
         eval_dir = Path(ecfg.eval_dir)
         eval_dir.mkdir(parents=True, exist_ok=True)
         self._sink = JsonlSink(eval_dir / "eval_log.jsonl")
+        from ..obsv.tb import SummaryWriter
+        self._tb = SummaryWriter(eval_dir / "tb")
         results: list[dict] = []
         try:
             while True:

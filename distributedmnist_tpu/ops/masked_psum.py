@@ -45,10 +45,13 @@ def masked_mean_psum(tree: Any, flag: jax.Array, axis_name: str) -> tuple[Any, j
     """
     flag = flag.astype(jnp.float32)
     num = lax.psum(flag, axis_name)
-    denom = jnp.maximum(num, 1.0)
+    # One elementwise pass per leaf: pre-scale by the SCALAR flag/denom
+    # so psum produces the mean directly (scaling after the psum would
+    # spend a second full-size HBM pass per leaf — measured as a real
+    # throughput tax on small step times by bench_mode_overhead).
+    scale = flag / jnp.maximum(num, 1.0)
     mean = jax.tree.map(
-        lambda g: lax.psum(g * flag.astype(g.dtype), axis_name) / denom.astype(g.dtype),
-        tree)
+        lambda g: lax.psum(g * scale.astype(g.dtype), axis_name), tree)
     return mean, num
 
 

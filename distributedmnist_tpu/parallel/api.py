@@ -53,7 +53,6 @@ class TrainState(struct.PyTreeNode):
     step: jax.Array          # int32, loop iterations
     updates_applied: jax.Array  # int32, ≙ global_step
     root_key: jax.Array
-    measured_ms: jax.Array   # host-injected real step time (scalar, ms)
     # interval mode only (None otherwise):
     window_acc: Any          # accumulated sum of per-step masked means
     window_rounds: jax.Array  # float32 rounds accumulated in this window
@@ -78,7 +77,8 @@ def state_partition_specs(model: Model, cfg: ExperimentConfig,
         raise ValueError(f"mesh has pipeline_parallelism={n_stage} but model "
                          f"{model.name!r} has no pipeline parameter specs")
     if n_stage > 1:
-        pspec: Any = model.pp_param_specs(topo.stage_axis)
+        pspec: Any = model.pp_param_specs(
+            topo.stage_axis, topo.model_axis if n_model > 1 else None)
     elif n_model > 1:
         pspec = model.tp_param_specs(topo.model_axis)
     else:
@@ -88,7 +88,7 @@ def state_partition_specs(model: Model, cfg: ExperimentConfig,
     return TrainState(
         params=pspec,
         momentum=pspec if has_momentum else None,
-        step=P_(), updates_applied=P_(), root_key=P_(), measured_ms=P_(),
+        step=P_(), updates_applied=P_(), root_key=P_(),
         window_acc=pspec if interval else None,
         window_rounds=P_(), wall_ms=P_(), next_apply_ms=P_())
 
@@ -110,7 +110,6 @@ def init_train_state(model: Model, cfg: ExperimentConfig,
         step=jnp.zeros((), jnp.int32),
         updates_applied=jnp.zeros((), jnp.int32),
         root_key=prng.root_key(cfg.train.seed),
-        measured_ms=jnp.zeros((), jnp.float32),
         window_acc=jax.tree.map(jnp.zeros_like, params) if interval else None,
         window_rounds=jnp.zeros((), jnp.float32),
         wall_ms=jnp.zeros((), jnp.float32),
@@ -148,9 +147,16 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
                      schedule: Schedule) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Compile the per-step SPMD training function.
 
-    Returns ``step_fn(state, batch) -> (state, metrics)`` where
-    ``batch = {"image": [B, ...], "label": [B]}`` is globally batched
-    and sharded over the replica axis, and state/metrics are replicated.
+    Returns ``step_fn(state, batch, measured_ms=None) -> (state, metrics)``
+    where ``batch = {"image": [B, ...], "label": [B]}`` is globally
+    batched and sharded over the replica axis, and state/metrics are
+    replicated. ``measured_ms`` is an optional per-replica [n] vector of
+    real measured step times (ms), sharded over the replica axis: each
+    host feeds the entries for its own replicas (Topology.
+    device_put_measured), so quorum/timeout/interval policies select on
+    genuine per-replica speed — ≙ the reference's measured per-worker
+    CDF semantics (src/timeout_manager.py:48-61) without the RPC mesh.
+    Defaults to zeros (pure synthetic-profile timing).
     """
     axis = topo.replica_axis
     n = topo.num_replicas
@@ -177,33 +183,36 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
     n_seq = topo.mesh.shape[seq_ax]
     model_ax = topo.model_axis
     n_model = topo.mesh.shape[model_ax]
-    if ((n_seq > 1 or n_model > 1)
-            and getattr(model, "sharded_apply_factory", None) is None):
-        raise ValueError(
-            f"mesh has seq_parallelism={n_seq} / model_parallelism="
-            f"{n_model} but model {model.name!r} supports neither "
-            "(no sharded_apply_factory)")
     # Pipeline parallelism: layers sharded over the stage axis, batch
     # microbatched through the activation pipeline (ops/pipeline.py).
     # Stage-sharded param grads stay local; replicated leaves (embed,
     # norms) get their stage-psum from the AD transpose of replication.
     stage_ax = topo.stage_axis
     n_stage = topo.mesh.shape[stage_ax]
+    if ((n_seq > 1 or n_model > 1) and n_stage == 1
+            and getattr(model, "sharded_apply_factory", None) is None):
+        raise ValueError(
+            f"mesh has seq_parallelism={n_seq} / model_parallelism="
+            f"{n_model} but model {model.name!r} supports neither "
+            "(no sharded_apply_factory)")
     if n_stage > 1:
         if getattr(model, "pp_apply_factory", None) is None:
             raise ValueError(f"mesh has pipeline_parallelism={n_stage} but "
                              f"model {model.name!r} has no pipeline apply")
-        if n_seq > 1 or n_model > 1:
+        if n_seq > 1:
             raise ValueError(
-                "pipeline parallelism currently composes with data "
-                "parallelism only (set model_parallelism=seq_parallelism=1)")
-        pp_apply = model.pp_apply_factory(stage_ax,
-                                          cfg.mesh.pipeline_microbatches)
+                "pipeline parallelism composes with data and tensor "
+                "parallelism, not (yet) sequence parallelism "
+                "(set seq_parallelism=1)")
+        # PP outermost; TP (when model axis > 1) inside each stage
+        pp_apply = model.pp_apply_factory(
+            stage_ax, cfg.mesh.pipeline_microbatches,
+            model_ax if n_model > 1 else None)
     else:
         pp_apply = None
     sharded_apply = (model.sharded_apply_factory(
         seq_ax if n_seq > 1 else None, model_ax if n_model > 1 else None)
-        if (n_seq > 1 or n_model > 1) else None)
+        if (n_seq > 1 or n_model > 1) and pp_apply is None else None)
     # The SP/PP loss paths do not thread a dropout key; refuse loudly
     # instead of silently training a dropout model without dropout.
     if ((sharded_apply is not None or pp_apply is not None)
@@ -273,9 +282,11 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         return (jnp.sum(nll * w) / total + aux_w * aux,
                 jnp.sum(correct * w) / total)
 
-    def shard_fn(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
+    def shard_fn(state: TrainState, batch: dict,
+                 measured_ms: jax.Array) -> tuple[TrainState, dict]:
         me = lax.axis_index(axis)
         step = state.step
+        my_measured_ms = measured_ms[0]  # this replica's [1]-shard
 
         # --- local forward+backward (one pass: the reference's second
         # forward per step, src/distributed_train.py:332-335, is a
@@ -314,7 +325,7 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
 
         # --- step-time model & contribution mask ---------------------
         t_ms = policies.sample_step_time_ms(sync, state.root_key, step, me,
-                                            state.measured_ms)
+                                            my_measured_ms)
         if mode in ("sync", "cdf"):
             flag = jnp.ones((), jnp.float32)
         elif mode == "quorum":
@@ -331,15 +342,25 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
             new_state, applied = _interval_apply(state, mean_grads, t_ms)
         else:
             lr = schedule(state.updates_applied)
-            new_params, new_bufs = _sgd(state.params, mean_grads,
-                                        state.momentum, lr, momentum)
             applied = (num_contrib > 0).astype(jnp.int32)
             # If every replica was masked out (possible under timeout),
             # the mean is zero and the update must be a true no-op.
-            new_params = jax.tree.map(
-                lambda new, old: jnp.where(applied > 0, new, old),
-                new_params, state.params)
-            if new_bufs is not None:
+            if state.momentum is None:
+                # plain SGD: lr·0 is exact, so scaling the scalar lr by
+                # the applied flag IS the no-op — no full-size
+                # per-parameter select pass (a measured throughput tax
+                # on small steps, bench_mode_overhead)
+                new_params, new_bufs = _sgd(
+                    state.params, mean_grads, None,
+                    lr * applied.astype(jnp.float32), momentum)
+            else:
+                new_params, new_bufs = _sgd(state.params, mean_grads,
+                                            state.momentum, lr, momentum)
+                # momentum buffers decay even on zero gradients, so a
+                # true no-op needs the select
+                new_params = jax.tree.map(
+                    lambda new, old: jnp.where(applied > 0, new, old),
+                    new_params, state.params)
                 new_bufs = jax.tree.map(
                     lambda new, old: jnp.where(applied > 0, new, old),
                     new_bufs, state.momentum)
@@ -417,10 +438,21 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
     batch_spec = P(axis, seq_ax) if sharded_apply else P(axis)
     sharded = jax.shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(state_specs, batch_spec),
+        in_specs=(state_specs, batch_spec, P(axis)),
         out_specs=(state_specs, metrics_specs))
+    jitted = jax.jit(sharded, donate_argnums=0)
 
-    return jax.jit(sharded, donate_argnums=0)
+    zeros_ms: list[jax.Array] = []  # lazily built + cached default
+
+    def step_fn(state: TrainState, batch: dict,
+                measured_ms: jax.Array | None = None):
+        if measured_ms is None:
+            if not zeros_ms:
+                zeros_ms.append(topo.zeros_measured())
+            measured_ms = zeros_ms[0]
+        return jitted(state, batch, measured_ms)
+
+    return step_fn
 
 
 def build_eval_step(model: Model, cfg: ExperimentConfig, topo: Topology):
@@ -440,8 +472,9 @@ def build_eval_step(model: Model, cfg: ExperimentConfig, topo: Topology):
         if getattr(model, "pp_apply_factory", None) is None:
             raise ValueError(f"mesh has pipeline_parallelism={n_stage} but "
                              f"model {model.name!r} has no pipeline apply")
-        pspec: Any = model.pp_param_specs(topo.stage_axis)
-        eval_pp_apply = model.pp_apply_factory(topo.stage_axis, 1)
+        tp_ax = model_ax if n_model > 1 else None
+        pspec: Any = model.pp_param_specs(topo.stage_axis, tp_ax)
+        eval_pp_apply = model.pp_apply_factory(topo.stage_axis, 1, tp_ax)
 
         def run(params, images):
             return eval_pp_apply(params, images)

@@ -229,7 +229,18 @@ def restore_checkpoint(train_dir: str | Path, template_state: Any,
             return None
     path = _ckpt_path(train_dir, step)
     payload = serialization.msgpack_restore(path.read_bytes())
-    state = serialization.from_state_dict(template_state, payload["state"])
+    saved = payload["state"]
+    # Migration: drop top-level fields the current TrainState no longer
+    # has (e.g. pre-round-3 checkpoints carried a measured_ms scalar) —
+    # from_state_dict hard-fails on unknown keys, which would make every
+    # old checkpoint unresumable instead of forward-compatible.
+    template_dict = serialization.to_state_dict(template_state)
+    if isinstance(saved, dict) and isinstance(template_dict, dict):
+        stale = set(saved) - set(template_dict)
+        if stale:
+            logger.warning("dropping stale checkpoint fields %s", sorted(stale))
+            saved = {k: v for k, v in saved.items() if k not in stale}
+    state = serialization.from_state_dict(template_state, saved)
     extra = payload.get("extra", {})
     if isinstance(extra, (str, bytes)):
         extra = json.loads(extra)

@@ -16,7 +16,6 @@ from pathlib import Path
 from typing import Any, Callable
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..core.config import ExperimentConfig
@@ -93,11 +92,21 @@ class Trainer:
             host_id=jax.process_index(), num_hosts=jax.process_count())
 
         self.collector = StepTimeCollector(num_replicas=n)
+        # Test/fault-injection seam: extra per-LOCAL-replica delay (ms)
+        # added onto the measured vector — lets tests (and chaos runs)
+        # make a specific replica the straggler deterministically.
+        self.delay_injection_ms: np.ndarray | None = None
         self.is_writer = jax.process_index() == 0
         self.train_dir = Path(cfg.train.train_dir)
         self._use_async_ckpt = cfg.train.async_checkpoint and self.is_writer
         self._checkpointer: ckpt.AsyncCheckpointer | None = None
         self._sink: JsonlSink | None = None
+        # TB scalars on the summary cadence (≙ chief summary writes,
+        # src/distributed_train.py:382-390)
+        self._tb = None
+        if self.is_writer and cfg.train.summary_every_steps > 0:
+            from ..obsv.tb import SummaryWriter
+            self._tb = SummaryWriter(self.train_dir / "tb")
         self._series: list[tuple[float, int, float, float]] = []  # (t, step, loss, acc)
         self._last_save_time = time.time()
         self._start_step = 0
@@ -182,12 +191,32 @@ class Trainer:
         pending: list[tuple[int, dict, float]] = []
         final_metrics: dict[str, float] = {}
         # With no synthetic straggler model, per-replica step times are
-        # driven by the real measured host step time (this is what paces
-        # interval windows / timeout deadlines on real hardware).
+        # driven by the real measured host step time: each process feeds
+        # its own measurement into its replicas' rows of the [n] vector
+        # (this is what paces interval windows / timeout deadlines and
+        # ranks quorum contributors on real hardware).
         inject_measured = (self.cfg.sync.straggler_profile == "none"
                            and self.cfg.sync.mode in ("interval", "timeout",
                                                       "quorum", "cdf"))
         host_dt = 0.0
+
+        can_measure = self.topo.measured_timing_supported
+        if (inject_measured or self.delay_injection_ms is not None) and not can_measure:
+            logger.warning(
+                "replicas don't split evenly over processes — per-host "
+                "measured timing disabled, policies run on the synthetic "
+                "model only")
+
+        def measured_vector() -> jax.Array | None:
+            if not can_measure or not (inject_measured
+                                       or self.delay_injection_ms is not None):
+                return None
+            local = np.full(self.topo.local_replica_count,
+                            host_dt * 1000.0 if inject_measured else 0.0,
+                            np.float32)
+            if self.delay_injection_ms is not None:
+                local = local + np.asarray(self.delay_injection_ms, np.float32)
+            return self.topo.device_put_measured(local)
 
         def flush(now: float) -> None:
             nonlocal final_metrics, last_log_t, last_log_step
@@ -206,9 +235,24 @@ class Trainer:
                     "updates_applied": int(m["updates_applied"]),
                     "num_contributors": float(m["num_contributors"]),
                     "examples_per_sec": rate,
+                    # per-replica contribution mask — which replicas'
+                    # gradients entered this step's masked mean
+                    "flags": np.asarray(m["flags"]).astype(int).tolist(),
                 }
                 self._sink_write(record)
                 final_metrics = record
+                if (self._tb is not None
+                        and s % self.cfg.train.summary_every_steps == 0):
+                    self._tb.add_scalars(
+                        {"train/loss": loss, "train/accuracy": acc,
+                         "train/learning_rate": record["lr"],
+                         "train/examples_per_sec": rate,
+                         "train/num_contributors":
+                             record["num_contributors"]},
+                        step=s, wall_time=t)
+                    # on-cadence flush: live `tensorboard --logdir`
+                    # sees the run, and a crash loses at most one window
+                    self._tb.flush()
                 if step_callback:
                     step_callback(s, record)
             # canonical line for the last flushed step
@@ -230,10 +274,8 @@ class Trainer:
             batch = next(self.train_iter)
             gbatch = self.topo.device_put_batch(batch,
                                                 seq_sharded=self.seq_sharded)
-            if inject_measured:
-                self.state = self.state.replace(
-                    measured_ms=jnp.float32(host_dt * 1000.0))
-            self.state, metrics = self.step_fn(self.state, gbatch)
+            self.state, metrics = self.step_fn(self.state, gbatch,
+                                               measured_vector())
             host_dt = time.time() - t0
             step += 1
             self.collector.add(metrics["step_times_ms"], host_dt)
@@ -265,6 +307,8 @@ class Trainer:
             self._checkpointer.close()
             self._checkpointer = None
         self._dump_series()
+        if self._tb is not None:
+            self._tb.flush()  # not closed: run() may be called again
         if self._sink:
             self._sink.close()
             self._sink = None

@@ -90,3 +90,33 @@ def test_measured_time_feeds_through():
     root = prng.root_key(0)
     t = float(policies.sample_step_time_ms(cfg, root, 0, 0, jnp.float32(123.0)))
     assert 123.0 <= t < 123.01  # base + sub-microsecond jitter
+
+
+def test_delayed_replica_is_the_one_masked(topo8, synthetic_datasets, tmp_path):
+    """End-to-end per-replica timing: with no synthetic straggler model,
+    the quorum mask must select on the REAL measured timing vector — an
+    artificially delayed replica is exactly the one masked every step
+    (≙ measured per-worker CDF timing driving backup-worker selection,
+    src/timeout_manager.py:48-61 + arXiv:1604.00981 semantics)."""
+    from distributedmnist_tpu.train.loop import Trainer
+    from tests.conftest import base_config
+
+    cfg = base_config(
+        sync={"mode": "quorum", "num_replicas_to_aggregate": 7,
+              "straggler_profile": "none"},
+        train={"max_steps": 4, "log_every_steps": 1,
+               "save_interval_steps": 0, "save_results_period": 0,
+               "train_dir": str(tmp_path / "train")},
+    )
+    trainer = Trainer(cfg, topo=topo8, datasets=synthetic_datasets)
+    delay = np.zeros(topo8.local_replica_count, np.float32)
+    delay[3] = 5000.0  # replica 3 is a severe straggler
+    trainer.delay_injection_ms = delay
+
+    records = []
+    trainer.run(step_callback=lambda s, r: records.append(r))
+    assert len(records) == 4
+    for r in records:
+        assert r["flags"][3] == 0, r  # the delayed replica is masked
+        assert sum(r["flags"]) == 7   # everyone else contributes
+        assert r["num_contributors"] == 7.0
