@@ -232,7 +232,8 @@ def _transformer(cfg: ModelConfig) -> Model:
 
         return apply_sharded
 
-    def pp_apply_factory(stage_axis: str, num_microbatches: int):
+    def pp_apply_factory(stage_axis: str, num_microbatches: int,
+                         model_axis: str | None = None):
         if moe:
             raise ValueError("mixture-of-experts does not yet compose with "
                              "pipeline parallelism (aux loss cannot cross "
@@ -242,7 +243,8 @@ def _transformer(cfg: ModelConfig) -> Model:
             return transformer.apply_pp(
                 params, tokens, num_heads=cfg.num_heads,
                 stage_axis=stage_axis, num_microbatches=num_microbatches,
-                attention_fn=attention_fn, compute_dtype=compute_dtype)
+                attention_fn=attention_fn, model_axis=model_axis,
+                compute_dtype=compute_dtype)
         return apply_pp
 
     return Model(name=cfg.name, init=init, apply=apply,

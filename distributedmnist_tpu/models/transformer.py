@@ -208,14 +208,24 @@ def stack_block_params(params: Params) -> Params:
             "blocks": stacked}
 
 
-def pp_param_partition_specs(stage_axis: str) -> Params:
+def pp_param_partition_specs(stage_axis: str,
+                             model_axis: str | None = None) -> Params:
     """Stacked-layout specs: block leaves sharded on the layer dim over
     the stage axis; embeddings/norms replicated (their gradients psum
-    over stages via the AD transpose of the replication)."""
+    over stages via the AD transpose of the replication).
+
+    ``model_axis`` composes Megatron TP inside each stage: the same
+    column/row dims as :func:`param_partition_specs`, one position to
+    the right of the stacked layer dim (PP outermost, TP within the
+    stage's layer slice)."""
     P = PartitionSpec
-    blk = {"ln1": {"scale": P(stage_axis)}, "wqkv": P(stage_axis),
-           "wo": P(stage_axis), "ln2": {"scale": P(stage_axis)},
-           "w1": P(stage_axis), "w2": P(stage_axis)}
+    m = model_axis  # None → replicated on the TP dims
+    blk = {"ln1": {"scale": P(stage_axis)},
+           "wqkv": P(stage_axis, None, None, m),
+           "wo": P(stage_axis, m, None),
+           "ln2": {"scale": P(stage_axis)},
+           "w1": P(stage_axis, None, m),
+           "w2": P(stage_axis, m, None)}
     return {"embed": P(), "pos": P(), "blocks": blk,
             "final_norm": {"scale": P()}}
 
@@ -224,6 +234,7 @@ def apply_pp(params: Params, tokens: jax.Array, *, num_heads: int,
              stage_axis: str, num_microbatches: int,
              attention_fn: Callable | None = None,
              positions: jax.Array | None = None,
+             model_axis: str | None = None,
              compute_dtype=jnp.bfloat16) -> jax.Array:
     """Pipeline-parallel forward (inside shard_map, params in the
     stacked layout with block leaves sharded over ``stage_axis``).
@@ -232,6 +243,12 @@ def apply_pp(params: Params, tokens: jax.Array, *, num_heads: int,
     local layer slice; activations flow via the microbatch pipeline
     (ops/pipeline.py). Embedding/head run replicated on every stage —
     outputs are stage-replicated logits, so loss code is unchanged.
+
+    ``model_axis`` composes tensor parallelism INSIDE each stage: block
+    params additionally carry Megatron column/row shards
+    (``pp_param_partition_specs(stage, model)``), each rank computes its
+    head/MLP slice, and the row-parallel psums inside ``_apply_block``
+    reassemble activations per tick — PP outermost, TP within.
     """
     from ..ops.pipeline import pipeline_apply
 
@@ -245,14 +262,18 @@ def apply_pp(params: Params, tokens: jax.Array, *, num_heads: int,
     p = jax.tree.map(lambda a: a.astype(compute_dtype), params)
     d = p["embed"].shape[-1]
     hd = d // num_heads
+    m = lax.axis_size(model_axis) if model_axis else 1
+    if num_heads % m != 0:
+        raise ValueError(f"num_heads={num_heads} not divisible by "
+                         f"model-parallel size {m}")
     x = p["embed"][tokens] + p["pos"][positions]
     mb = b // num_microbatches
     micro = x.reshape(num_microbatches, mb, s, d)
 
     def stage_fn(act):
         def layer(carry, blk):
-            out, _aux = _apply_block(carry, blk, h_local=num_heads, hd=hd,
-                                     attn=attn, model_axis=None)
+            out, _aux = _apply_block(carry, blk, h_local=num_heads // m,
+                                     hd=hd, attn=attn, model_axis=model_axis)
             return out, None
 
         out, _ = lax.scan(layer, act, p["blocks"])

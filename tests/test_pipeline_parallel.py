@@ -72,15 +72,19 @@ def _dense_update(cfg, batch):
     return loss, jax.tree.map(lambda p, g: p - LR * g, params, grads)
 
 
-@pytest.mark.parametrize("n_replicas,n_stage,microbatches", [
-    (1, 4, 4),
-    (2, 4, 2),   # DP × PP
-    (1, 2, 1),   # single microbatch (pure layer split)
+@pytest.mark.parametrize("n_replicas,n_stage,n_model,microbatches", [
+    (1, 4, 1, 4),
+    (2, 4, 1, 2),   # DP × PP
+    (1, 2, 1, 1),   # single microbatch (pure layer split)
+    (2, 2, 2, 2),   # DP × PP × TP: stage outermost, Megatron inside
+    (1, 2, 4, 2),   # PP × wide TP
 ])
-def test_pp_step_matches_dense_update(n_replicas, n_stage, microbatches):
+def test_pp_step_matches_dense_update(n_replicas, n_stage, n_model,
+                                      microbatches):
     cfg = _cfg(n_replicas=n_replicas)
     cfg = cfg.override({"mesh.num_replicas": n_replicas,
                         "mesh.pipeline_parallelism": n_stage,
+                        "mesh.model_parallelism": n_model,
                         "mesh.pipeline_microbatches": microbatches})
     batch = _tokens(cfg)
     want_loss, want_params = _dense_update(cfg, batch)
@@ -101,11 +105,13 @@ def test_pp_step_matches_dense_update(n_replicas, n_stage, microbatches):
                                    rtol=3e-4, atol=3e-5)
 
 
-def test_pp_rejects_tp_combo():
+def test_pp_rejects_sp_combo():
+    """PP×SP remains an explicit refusal (the one composition gap —
+    recorded in PARITY.md), while PP×TP now builds."""
     cfg = _cfg()
-    topo = make_topology(MeshConfig(num_replicas=2, model_parallelism=2,
+    topo = make_topology(MeshConfig(num_replicas=2, seq_parallelism=2,
                                     pipeline_parallelism=2))
-    with pytest.raises(ValueError, match="composes with data"):
+    with pytest.raises(ValueError, match="seq_parallelism=1"):
         build_train_step(get_model(cfg.model), cfg, topo, constant(LR))
 
 
