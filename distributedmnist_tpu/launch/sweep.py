@@ -107,28 +107,44 @@ def run_sweep(configs: Iterable[ExperimentConfig], results_dir: str | Path,
     return records
 
 
-def write_report(records: list[dict[str, Any]], results_dir: str | Path) -> Path:
+def write_report(records: list[dict[str, Any]], results_dir: str | Path,
+                 acc_threshold: float = 0.97) -> Path:
     """Markdown summary table + optional CDF/convergence plots
-    (≙ the matplotlib figures, tools/benchmark.py:165-263)."""
+    (≙ the matplotlib figures, tools/benchmark.py:165-263).
+
+    ``steps→{acc_threshold}`` is the convergence-speed column: on a
+    separable dataset every discipline eventually converges, so the
+    tradeoff the quorum/interval sweeps exist to show lives in HOW FAST
+    each one gets there, not in the (flat) final accuracy."""
+    from ..obsv.report import load_jsonl, steps_to_accuracy
+
     results_dir = Path(results_dir)
     lines = [
         "# Sweep report", "",
-        "| name | mode | k | steps | updates | test acc | ex/s | "
+        f"| name | mode | k | steps | updates | test acc | "
+        f"steps→{acc_threshold:.0%} acc | ex/s | "
         "barrier p50 (ms) | barrier p99 (ms) |",
-        "|---|---|---|---|---|---|---|---|---|",
+        "|---|---|---|---|---|---|---|---|---|---|",
     ]
+    step_series = {
+        r["name"]: load_jsonl(
+            results_dir / r["name"] / "train" / "train_log.jsonl", "step")
+        for r in records}
     for r in records:
         b = r["timing"]["barrier"]
+        to_acc = steps_to_accuracy(step_series[r["name"]], acc_threshold)
         lines.append(
             f"| {r['name']} | {r['mode']} | {r['aggregate_k']} | {r['steps']} "
             f"| {r['updates_applied']} | {r['test_accuracy']:.4f} "
+            f"| {to_acc if to_acc is not None else '—'} "
             f"| {r['examples_per_sec'] or 0:.0f} | {b.get('p50', 0):.3f} "
             f"| {b.get('p99', 0):.3f} |")
     report = results_dir / "report.md"
     report.write_text("\n".join(lines) + "\n")
     try:
-        from ..obsv.report import plot_sweep
+        from ..obsv.report import plot_group_overlays, plot_sweep
         plot_sweep(records, results_dir)
+        plot_group_overlays(records, results_dir, step_series=step_series)
     except Exception as e:  # plotting is best-effort, never fails a sweep
         logger.warning("plotting skipped: %s", e)
     return report

@@ -237,6 +237,51 @@ def plot_sweep(records: list[dict[str, Any]], out_dir: str | Path) -> list[Path]
     return written
 
 
+def steps_to_accuracy(steps: list[dict], threshold: float) -> int | None:
+    """First logged step whose train accuracy reaches ``threshold`` —
+    the convergence-SPEED metric for sweeps where every discipline
+    eventually converges (final-accuracy curves go flat)."""
+    for s in steps:
+        if s.get("train_acc", 0.0) >= threshold:
+            return int(s["step"])
+    return None
+
+
+def plot_group_overlays(records: list[dict[str, Any]],
+                        results_dir: str | Path,
+                        step_series: dict[str, list[dict]] | None = None
+                        ) -> list[Path]:
+    """Cross-experiment per-step overlays for one sweep group: train
+    loss vs step and train accuracy vs step, one curve per experiment
+    (≙ the reference's multi-cfg step_loss overlays,
+    tools/benchmark.py:165-224). Reads each experiment's
+    train_log.jsonl from ``results_dir/<name>/train`` unless the caller
+    already loaded the series (``step_series``: name → step records)."""
+    results_dir = Path(results_dir)
+    series = []
+    for r in records:
+        steps = (step_series.get(r["name"]) if step_series is not None
+                 else load_jsonl(results_dir / r["name"] / "train"
+                                 / "train_log.jsonl", "step"))
+        if steps:
+            series.append((r["name"], steps))
+    if not series:
+        return []
+    written = []
+    for key, ylabel, fname in (("loss", "train loss", "group_step_loss.png"),
+                               ("train_acc", "train accuracy",
+                                "group_step_acc.png")):
+        fig, ax = _axes(f"{results_dir.name}: {ylabel} vs step",
+                        "global step", ylabel)
+        for name, steps in series:
+            xs = [s["step"] for s in steps]
+            ys = [s[key] for s in steps]
+            ax.plot(xs, ys, label=name, linewidth=1.0, alpha=0.85)
+        ax.legend(fontsize=7)
+        written.append(_save(fig, results_dir / fname))
+    return written
+
+
 def generate_report(train_dir: str | Path, eval_dir: str | Path | None,
                     out_dir: str | Path, name: str = "experiment") -> dict:
     """One-stop: load logs → stats.json + figures. Returns the stats."""

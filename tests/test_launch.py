@@ -46,7 +46,10 @@ def test_load_sweep_configs_rejects_duplicates(tmp_path):
 
 
 def test_repo_sweep_configs_all_parse():
-    """Every shipped config in configs/ must load cleanly."""
+    """Every shipped config must load cleanly — the grid in configs/
+    AND every config in subdirectories (configs/repro/…), so a broken
+    repro config can't hide from CI behind the non-recursive sweep
+    loader."""
     from pathlib import Path
     from distributedmnist_tpu.launch.sweep import load_sweep_configs
     root = Path(__file__).resolve().parent.parent / "configs"
@@ -54,6 +57,11 @@ def test_repo_sweep_configs_all_parse():
     assert len(cfgs) >= 15
     modes = {c.sync.mode for c in cfgs}
     assert {"quorum", "interval", "cdf", "sync", "timeout"} <= modes
+    subdir_cfgs = [load_sweep_configs(f)[0]
+                   for sub in sorted(p for p in root.iterdir() if p.is_dir())
+                   for f in sorted(sub.glob("*.json"))]
+    names = {c.name for c in subdir_cfgs}
+    assert "mnist_99" in names  # the one-command 99% repro config
 
 
 def test_cli_devices(capsys):

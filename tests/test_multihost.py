@@ -42,13 +42,16 @@ from distributedmnist_tpu.train.loop import Trainer
 
 cfg = ExperimentConfig.from_dict(json.loads(os.environ["DML_CFG"]))
 t = Trainer(cfg)
+start_step = t._start_step
 summary = t.run()
 ev = t.evaluate()
 leaves = jax.tree.leaves(jax.device_get(t.state.params))
+times = t.collector.matrix()
 print("RESULT " + json.dumps({
     "process_count": jax.process_count(),
     "local_devices": jax.local_device_count(),
     "global_devices": len(jax.devices()),
+    "start_step": start_step,
     "final_step": summary["final_step"],
     "loss": summary["last_metrics"]["loss"],
     "param_l1": float(sum(np.abs(np.asarray(x), dtype=np.float64).sum()
@@ -56,6 +59,12 @@ print("RESULT " + json.dumps({
     "eval_accuracy": ev["accuracy"],
     "eval_loss": ev["loss"],
     "eval_num_examples": ev["num_examples"],
+    # the multi-host-safety claim under test: every process holds the
+    # full replicated [n] timing vector and contribution flags
+    # (parallel/api._gather_replicated's one-hot psum)
+    "flags": summary["last_metrics"]["flags"],
+    "num_contributors": summary["last_metrics"]["num_contributors"],
+    "last_step_times": times[-1].tolist() if times.size else [],
 }))
 """
 
@@ -84,7 +93,7 @@ def _cfg_dict(train_dir: str) -> dict:
     }
 
 
-def _launch(tmp_path):
+def _launch(tmp_path, cfg_dicts=None):
     port = _free_port()
     procs = []
     for pid in range(2):
@@ -95,7 +104,8 @@ def _launch(tmp_path):
         env["JAX_NUM_PROCESSES"] = "2"
         env["JAX_PROCESS_ID"] = str(pid)
         env["DML_CFG"] = json.dumps(
-            _cfg_dict(str(tmp_path / f"multihost_p{pid}")))
+            cfg_dicts[pid] if cfg_dicts is not None
+            else _cfg_dict(str(tmp_path / f"multihost_p{pid}")))
         procs.append(subprocess.Popen(
             [sys.executable, "-c", _CHILD], env=env, cwd=os.getcwd(),
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
@@ -146,3 +156,82 @@ def test_two_process_training_matches_single_process(tmp_path):
     np.testing.assert_allclose(r0["eval_accuracy"], ev["accuracy"],
                                rtol=1e-5, atol=1e-6)
     assert ev["num_examples"] == 96
+
+
+def test_two_process_quorum_gathers_on_every_host(tmp_path):
+    """Quorum mode across two live processes: the k-of-n mask, the
+    replicated [n] timing vector and the flags gather — the exact paths
+    `_gather_replicated` exists for (parallel/api.py: a one-hot psum is
+    statically replicated, so non-addressable processes can materialize
+    it; an all_gather could not leave shard_map replicated) — must
+    produce identical values on BOTH hosts, and match the seeded
+    single-process run."""
+    def qcfg(train_dir):
+        d = _cfg_dict(train_dir)
+        d["sync"] = {"mode": "quorum", "num_replicas_to_aggregate": 6,
+                     "straggler_profile": "lognormal"}
+        d["train"]["max_steps"] = 3
+        return d
+
+    r0, r1 = _launch(tmp_path, [qcfg(str(tmp_path / "q_p0")),
+                                qcfg(str(tmp_path / "q_p1"))])
+    for r in (r0, r1):
+        assert r["global_devices"] == 8
+        assert r["num_contributors"] == 6.0
+        assert sum(r["flags"]) == 6
+        assert len(r["last_step_times"]) == 8
+    # every host holds the same replicated vectors
+    assert r0["flags"] == r1["flags"]
+    np.testing.assert_allclose(r0["last_step_times"], r1["last_step_times"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(r0["loss"], r1["loss"], rtol=1e-6)
+
+    # the straggler model is keyed by (seed, step, replica) — a
+    # single-process run with the same config selects the same quorum.
+    # (No loss parity here, deliberately: masking replica r drops
+    # whichever ROWS replica r holds, and the host-sharded ingest
+    # assigns different rows per replica across launch shapes — only
+    # the selection itself is layout-invariant.)
+    from distributedmnist_tpu.train.loop import Trainer
+    records = []
+    cfg = base_config(**qcfg(str(tmp_path / "q_single")))
+    t = Trainer(cfg)
+    t.run(step_callback=lambda s, rec: records.append(rec))
+    assert records[-1]["flags"] == r0["flags"]
+
+
+def test_two_process_save_kill_resume(tmp_path):
+    """Checkpoint/resume across process death on a live two-process
+    cluster: phase 1 trains 4 steps into a SHARED train_dir (process 0
+    is the writer, ≙ the chief's NFS checkpoints,
+    tools/tf_ec2.py:61-68) and the cluster dies; phase 2's fresh
+    processes must both restore step 4 and finish at 8 with exactly the
+    params a never-killed single-process 8-step run produces."""
+    shared = str(tmp_path / "mh_shared")
+
+    def pcfg(max_steps):
+        d = _cfg_dict(shared)
+        d["train"]["max_steps"] = max_steps
+        return d
+
+    r0, r1 = _launch(tmp_path, [pcfg(4), pcfg(4)])
+    assert r0["start_step"] == r1["start_step"] == 0
+    assert r0["final_step"] == r1["final_step"] == 4
+
+    s0, s1 = _launch(tmp_path, [pcfg(8), pcfg(8)])
+    for s in (s0, s1):
+        assert s["start_step"] == 4, "resume must pick up the checkpoint"
+        assert s["final_step"] == 8
+    np.testing.assert_allclose(s0["param_l1"], s1["param_l1"], rtol=1e-6)
+
+    # exact-resume oracle: one uninterrupted 8-step run
+    from distributedmnist_tpu.train.loop import Trainer
+    import jax
+    cfg = base_config(**_cfg_dict(str(tmp_path / "oracle")))
+    cfg = cfg.override({"train.max_steps": 8})
+    t = Trainer(cfg)
+    t.run()
+    leaves = jax.tree.leaves(jax.device_get(t.state.params))
+    param_l1 = float(sum(np.abs(np.asarray(x), dtype=np.float64).sum()
+                         for x in leaves))
+    np.testing.assert_allclose(s0["param_l1"], param_l1, rtol=1e-6)
