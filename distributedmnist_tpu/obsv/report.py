@@ -237,14 +237,43 @@ def plot_sweep(records: list[dict[str, Any]], out_dir: str | Path) -> list[Path]
     return written
 
 
-def steps_to_accuracy(steps: list[dict], threshold: float) -> int | None:
-    """First logged step whose train accuracy reaches ``threshold`` —
-    the convergence-SPEED metric for sweeps where every discipline
-    eventually converges (final-accuracy curves go flat)."""
+def steps_to_loss(steps: list[dict], threshold: float) -> int | None:
+    """First logged step whose train loss falls to ``threshold``. With
+    reference-parity dropout the train-acc forward runs at p=0.5, so
+    loss is the usable per-step convergence signal."""
     for s in steps:
-        if s.get("train_acc", 0.0) >= threshold:
+        if s.get("loss", float("inf")) <= threshold:
             return int(s["step"])
     return None
+
+
+def modeled_step_durations_ms(steps: list[dict],
+                              step_times: np.ndarray | None) -> np.ndarray | None:
+    """Per-step MODELED barrier: the slowest CONTRIBUTING replica's
+    sampled time — the wall-clock cost the aggregation discipline
+    actually pays. Under quorum k-of-n this is the k-th order statistic
+    of the per-replica times (backups past it are not waited for,
+    arXiv:1604.00981's core effect); under full sync/cdf it is the max.
+
+    This is what the reference's Experiment A measures on real EC2
+    stragglers: convergence per STEP is nearly k-invariant (any masked
+    mean is an unbiased gradient), so the whole quorum tradeoff lives
+    in how long each step takes. Requires the per-step `flags` record
+    and the [steps, n] step_times matrix."""
+    if step_times is None or not len(step_times):
+        return None
+    out = []
+    for rec in steps:
+        i = rec["step"] - 1
+        if not (0 <= i < len(step_times)):
+            return None  # resumed run: rows don't align with steps
+        row = step_times[i]
+        flags = rec.get("flags")
+        if flags and sum(flags) and len(flags) == len(row):
+            out.append(max(t for t, f in zip(row, flags) if f))
+        else:
+            out.append(float(row.max()))
+    return np.asarray(out)
 
 
 def plot_group_overlays(records: list[dict[str, Any]],
@@ -279,6 +308,31 @@ def plot_group_overlays(records: list[dict[str, Any]],
             ax.plot(xs, ys, label=name, linewidth=1.0, alpha=0.85)
         ax.legend(fontsize=7)
         written.append(_save(fig, results_dir / fname))
+
+    # loss vs MODELED wall-clock (cumulative contributor-barrier): the
+    # discipline tradeoff the step-axis overlays can't show — under
+    # heavy-tailed stragglers small k pays far less time per step at
+    # near-identical per-step convergence (≙ the reference's
+    # time_loss/time_precision figures, tools/benchmark.py:165-224)
+    fig, ax = _axes(f"{results_dir.name}: train loss vs modeled wall-clock",
+                    "modeled seconds (cumulative contributor barrier)",
+                    "train loss")
+    drew = False
+    for name, steps in series:
+        st = results_dir / name / "train" / "step_times.npy"
+        durations = modeled_step_durations_ms(
+            steps, np.load(st) if st.exists() else None)
+        if durations is None:
+            continue
+        ax.plot(np.cumsum(durations) / 1e3, [s["loss"] for s in steps],
+                label=name, linewidth=1.0, alpha=0.85)
+        drew = True
+    if drew:
+        ax.legend(fontsize=7)
+        written.append(_save(fig, results_dir / "group_modeled_time_loss.png"))
+    else:
+        import matplotlib.pyplot as plt
+        plt.close(fig)
     return written
 
 

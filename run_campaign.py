@@ -120,13 +120,13 @@ def start_evaluator(run_dir: Path) -> subprocess.Popen:
     reference's separate evaluator machine (tools/tf_ec2.py:130-146)."""
     run_dir.mkdir(parents=True, exist_ok=True)
     eval_dir = run_dir / "eval"
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "distributedmnist_tpu.launch", "eval",
-         "--train_dir", str(run_dir / "train"),
-         "--eval_dir", str(eval_dir),
-         "--eval_interval_secs", "2.0"],
-        stdout=open(run_dir / "evaluator_stdout.log", "w"),
-        stderr=subprocess.STDOUT)
+    with open(run_dir / "evaluator_stdout.log", "w") as log:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "distributedmnist_tpu.launch", "eval",
+             "--train_dir", str(run_dir / "train"),
+             "--eval_dir", str(eval_dir),
+             "--eval_interval_secs", "2.0"],
+            stdout=log, stderr=subprocess.STDOUT)  # child keeps its dup
     logger.info("evaluator pid %d watching %s", proc.pid, run_dir / "train")
     return proc
 
@@ -151,14 +151,40 @@ def prune_heavy_artifacts(results_dir: Path) -> None:
         p.unlink()
 
 
+def finalize(results_dir: Path) -> None:
+    """Regenerate every group's report.md/figures from its
+    sweep_results.jsonl with the CURRENT analysis code, rebuild the
+    top-level summary from what's on disk, and prune checkpoint
+    payloads — idempotent, safe to run after partial/rerun campaigns."""
+    summary = {}
+    for gdir in sorted(p for p in results_dir.iterdir() if p.is_dir()):
+        f = gdir / "sweep_results.jsonl"
+        if not f.exists():
+            continue
+        records = [json.loads(l) for l in f.read_text().splitlines()
+                   if l.strip()]
+        write_report(records, gdir)
+        summary[gdir.name] = [{k: r.get(k) for k in
+                               ("name", "test_accuracy", "examples_per_sec",
+                                "updates_applied")} for r in records]
+        logger.info("finalized %s (%d experiments)", gdir.name, len(records))
+    (results_dir / "campaign_summary.json").write_text(
+        json.dumps({"groups": summary}, indent=2))
+    prune_heavy_artifacts(results_dir)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--results", default=str(REPO / "results"))
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--groups", default=",".join(GROUPS))
+    ap.add_argument("--finalize-only", action="store_true")
     args = ap.parse_args()
     results_dir = Path(args.results)
     results_dir.mkdir(parents=True, exist_ok=True)
+    if args.finalize_only:
+        finalize(results_dir)
+        return 0
 
     for ds in ("mnist", "fashion_mnist"):
         materialize_idx_fixture(DATA_DIR / ds, ds)

@@ -120,3 +120,20 @@ def test_delayed_replica_is_the_one_masked(topo8, synthetic_datasets, tmp_path):
         assert r["flags"][3] == 0, r  # the delayed replica is masked
         assert sum(r["flags"]) == 7   # everyone else contributes
         assert r["num_contributors"] == 7.0
+
+
+def test_measured_timing_unsupported_on_uneven_meshes(topo8, monkeypatch):
+    """When replicas don't split evenly over processes (e.g. cross-host
+    TP with num_replicas < processes) per-host measured timing has no
+    well-defined owner: device_put_measured must refuse, while the
+    zeros default (identical everywhere) still works."""
+    import jax as _jax
+    monkeypatch.setattr(_jax, "process_count", lambda: 3)
+    assert not topo8.measured_timing_supported
+    with np.testing.assert_raises(ValueError):
+        topo8.device_put_measured(np.zeros(2, np.float32))
+    # the zeros default must work even on the uneven mesh (identical
+    # values whoever materializes them) — asserted BEFORE undo
+    z = topo8.zeros_measured()
+    assert z.shape == (8,)
+    np.testing.assert_array_equal(np.asarray(z), np.zeros(8))
