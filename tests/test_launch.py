@@ -37,6 +37,34 @@ def test_run_sweep_report(tmp_path, synthetic_datasets):
     assert (tmp_path / "step_time_cdf.png").exists()
 
 
+def test_campaign_finalize_regenerates_reports(tmp_path, synthetic_datasets):
+    """run_campaign.finalize rebuilds every group report + the summary
+    from sweep_results.jsonl on disk, prunes checkpoint payloads, and
+    is idempotent — the recovery path when analysis code improves after
+    a multi-hour campaign already ran."""
+    import run_campaign
+    from distributedmnist_tpu.launch.sweep import run_sweep
+
+    gdir = tmp_path / "groupA"
+    cfgs = [base_config(name=f"s{k}",
+                        sync={"mode": "quorum", "num_replicas_to_aggregate": k,
+                              "straggler_profile": "lognormal"},
+                        train={"max_steps": 8, "log_every_steps": 4})
+            for k in (2, 8)]
+    run_sweep(cfgs, gdir, datasets=synthetic_datasets)
+    (gdir / "report.md").unlink()  # simulate stale/missing analysis
+    assert list(gdir.rglob("ckpt-*.msgpack"))
+
+    run_campaign.finalize(tmp_path)
+    report = (gdir / "report.md").read_text()
+    assert "modeled" in report and "s2" in report
+    summary = json.loads((tmp_path / "campaign_summary.json").read_text())
+    assert [r["name"] for r in summary["groups"]["groupA"]] == ["s2", "s8"]
+    assert not list(gdir.rglob("ckpt-*.msgpack"))  # pruned
+    run_campaign.finalize(tmp_path)  # idempotent
+    assert (gdir / "report.md").exists()
+
+
 def test_load_sweep_configs_rejects_duplicates(tmp_path):
     from distributedmnist_tpu.launch.sweep import load_sweep_configs
     (tmp_path / "a.json").write_text(json.dumps({"name": "dup"}))
