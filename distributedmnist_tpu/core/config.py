@@ -85,6 +85,11 @@ class ModelConfig:
     num_experts: int = 0
     expert_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # Rematerialize each transformer block in the backward pass
+    # (jax.checkpoint): activation memory per layer drops from O(all
+    # intermediates) to O(block boundary), bought with one extra
+    # forward — the standard HBM/FLOPs trade for long sequences.
+    remat: bool = False
 
 
 @dataclass(frozen=True)

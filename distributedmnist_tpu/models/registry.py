@@ -185,21 +185,23 @@ def _transformer(cfg: ModelConfig) -> Model:
                                  compute_dtype=compute_dtype,
                                  num_experts=cfg.num_experts,
                                  capacity_factor=cfg.expert_capacity_factor,
+                                 remat=cfg.remat,
                                  return_aux=return_aux)
 
-    def sharded_apply_factory(seq_axis: str | None, model_axis: str | None):
-        """Sharded apply for the DP×SP×TP train step: tokens arrive as
-        [b, seq_local] slices; attention crosses seq shards via the
-        configured strategy; params may be tensor-parallel shards."""
+    def make_seq_attn(seq_axis: str | None):
+        """The attention callable for a given seq sharding: the plain
+        configured kernel when unsharded, else ring / Ulysses over the
+        axis (shared by the SP/TP path and the pipeline path)."""
         if seq_axis is None:
-            sharded_attn = attention_fn  # flash or dense, per attention_impl
-        elif cfg.sp_attention == "ring":
+            return attention_fn  # flash or dense, per attention_impl
+        if cfg.sp_attention == "ring":
             from ..ops.ring_attention import ring_self_attention
 
             def sharded_attn(q, k, v, causal=True, scale=None):
                 return ring_self_attention(q, k, v, seq_axis, causal=causal,
                                            scale=scale)
-        elif cfg.sp_attention == "ulysses":
+            return sharded_attn
+        if cfg.sp_attention == "ulysses":
             from ..ops.ulysses_attention import ulysses_self_attention
             inner = attention_fn
 
@@ -207,8 +209,14 @@ def _transformer(cfg: ModelConfig) -> Model:
                 return ulysses_self_attention(q, k, v, seq_axis,
                                               causal=causal, scale=scale,
                                               attention_fn=inner)
-        else:
-            raise ValueError(f"unknown sp_attention {cfg.sp_attention!r}")
+            return sharded_attn
+        raise ValueError(f"unknown sp_attention {cfg.sp_attention!r}")
+
+    def sharded_apply_factory(seq_axis: str | None, model_axis: str | None):
+        """Sharded apply for the DP×SP×TP train step: tokens arrive as
+        [b, seq_local] slices; attention crosses seq shards via the
+        configured strategy; params may be tensor-parallel shards."""
+        sharded_attn = make_seq_attn(seq_axis)
 
         if moe and seq_axis is not None:
             raise ValueError("mixture-of-experts does not yet compose with "
@@ -228,23 +236,27 @@ def _transformer(cfg: ModelConfig) -> Model:
                                      expert_axis=ep_axis,
                                      num_experts=cfg.num_experts,
                                      capacity_factor=cfg.expert_capacity_factor,
+                                     remat=cfg.remat,
                                      return_aux=return_aux)
 
         return apply_sharded
 
     def pp_apply_factory(stage_axis: str, num_microbatches: int,
-                         model_axis: str | None = None):
+                         model_axis: str | None = None,
+                         seq_axis: str | None = None):
         if moe:
             raise ValueError("mixture-of-experts does not yet compose with "
                              "pipeline parallelism (aux loss cannot cross "
                              "the stage pipeline)")
+        pp_attn = make_seq_attn(seq_axis)
 
-        def apply_pp(params, tokens):
+        def apply_pp(params, tokens, positions=None):
             return transformer.apply_pp(
                 params, tokens, num_heads=cfg.num_heads,
                 stage_axis=stage_axis, num_microbatches=num_microbatches,
-                attention_fn=attention_fn, model_axis=model_axis,
-                compute_dtype=compute_dtype)
+                attention_fn=pp_attn, positions=positions,
+                model_axis=model_axis,
+                compute_dtype=compute_dtype, remat=cfg.remat)
         return apply_pp
 
     return Model(name=cfg.name, init=init, apply=apply,

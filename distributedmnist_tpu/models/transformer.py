@@ -113,7 +113,7 @@ def apply(params: Params, tokens: jax.Array, *, num_heads: int = 4,
           compute_dtype=jnp.bfloat16,
           model_axis: str | None = None,
           expert_axis: str | None = None, num_experts: int = 0,
-          capacity_factor: float = 1.25,
+          capacity_factor: float = 1.25, remat: bool = False,
           return_aux: bool = False) -> jax.Array:
     """tokens [batch, seq] int32 → logits [batch, seq, vocab] float32.
 
@@ -145,13 +145,21 @@ def apply(params: Params, tokens: jax.Array, *, num_heads: int = 4,
         raise ValueError(f"num_heads={num_heads} not divisible by "
                          f"model-parallel size {m}")
     h_local = num_heads // m
+
+    def block(x, blk):
+        return _apply_block(x, blk, h_local=h_local, hd=hd, attn=attn,
+                            model_axis=model_axis,
+                            expert_axis=expert_axis,
+                            num_experts=num_experts,
+                            capacity_factor=capacity_factor)
+
+    if remat:
+        # trade one extra forward per block for O(layer-boundary)
+        # activation memory — the long-sequence HBM lever
+        block = jax.checkpoint(block)
     aux_total = jnp.zeros((), jnp.float32)
     for blk in p["blocks"]:
-        x, aux = _apply_block(x, blk, h_local=h_local, hd=hd, attn=attn,
-                              model_axis=model_axis,
-                              expert_axis=expert_axis,
-                              num_experts=num_experts,
-                              capacity_factor=capacity_factor)
+        x, aux = block(x, blk)
         aux_total = aux_total + aux
     x = _rms_norm(x, p["final_norm"])
     logits = (x @ p["embed"].T).astype(jnp.float32)  # tied head
@@ -235,7 +243,7 @@ def apply_pp(params: Params, tokens: jax.Array, *, num_heads: int,
              attention_fn: Callable | None = None,
              positions: jax.Array | None = None,
              model_axis: str | None = None,
-             compute_dtype=jnp.bfloat16) -> jax.Array:
+             compute_dtype=jnp.bfloat16, remat: bool = False) -> jax.Array:
     """Pipeline-parallel forward (inside shard_map, params in the
     stacked layout with block leaves sharded over ``stage_axis``).
 
@@ -249,6 +257,12 @@ def apply_pp(params: Params, tokens: jax.Array, *, num_heads: int,
     (``pp_param_partition_specs(stage, model)``), each rank computes its
     head/MLP slice, and the row-parallel psums inside ``_apply_block``
     reassemble activations per tick — PP outermost, TP within.
+
+    Sequence parallelism composes through ``attention_fn`` +
+    ``positions``: pass a seq-sharded attention (ring/Ulysses over the
+    seq axis) and this shard's global positions; every (stage, seq)
+    device runs the same tick schedule, so the attention collectives
+    stay lockstep inside the pipeline scan — bubbles included.
     """
     from ..ops.pipeline import pipeline_apply
 
@@ -276,6 +290,8 @@ def apply_pp(params: Params, tokens: jax.Array, *, num_heads: int,
                                      hd=hd, attn=attn, model_axis=model_axis)
             return out, None
 
+        if remat:
+            layer = jax.checkpoint(layer)
         out, _ = lax.scan(layer, act, p["blocks"])
         return out
 

@@ -199,15 +199,14 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         if getattr(model, "pp_apply_factory", None) is None:
             raise ValueError(f"mesh has pipeline_parallelism={n_stage} but "
                              f"model {model.name!r} has no pipeline apply")
-        if n_seq > 1:
-            raise ValueError(
-                "pipeline parallelism composes with data and tensor "
-                "parallelism, not (yet) sequence parallelism "
-                "(set seq_parallelism=1)")
-        # PP outermost; TP (when model axis > 1) inside each stage
+        # PP outermost; TP (model axis) inside each stage; SP (seq
+        # axis) through the stage blocks' sharded attention — every
+        # (stage, seq) device runs the same tick schedule so the
+        # attention collectives stay lockstep inside the pipeline scan
         pp_apply = model.pp_apply_factory(
             stage_ax, cfg.mesh.pipeline_microbatches,
-            model_ax if n_model > 1 else None)
+            model_ax if n_model > 1 else None,
+            seq_ax if n_seq > 1 else None)
     else:
         pp_apply = None
     sharded_apply = (model.sharded_apply_factory(
@@ -245,8 +244,10 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         logits = pp_apply(params, batch["image"])  # stage-replicated
         return model.loss(logits, batch["label"]), logits
 
-    def local_loss_sp(params, batch, dropout_key):
-        """Per-(replica, seq-shard) partial next-token loss.
+    def make_sp_loss(apply_fn, with_aux):
+        """Per-(replica, seq-shard) partial next-token loss over any
+        seq-sharded apply (the DP×SP×TP path, or the pipeline apply for
+        PP×SP).
 
         Targets are inputs shifted left by one GLOBAL position, so the
         target of a shard's last token lives on the next shard — one
@@ -255,32 +256,39 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         ``transformer.loss_fn`` exactly: partial sums are normalized by
         the global valid-token count so psum(partials) == dense loss.
         """
-        del dropout_key
-        tokens = batch["image"]
-        labels = batch["label"]
-        b, s_loc = tokens.shape
-        me_s = lax.axis_index(seq_ax)
-        positions = me_s * s_loc + jnp.arange(s_loc)
-        if has_aux:  # EP path (seq axis is size 1 — guarded in registry)
-            logits, aux = sharded_apply(params, tokens, positions,
-                                        return_aux=True)
-        else:
-            logits = sharded_apply(params, tokens, positions)  # [b, s_loc, V]
-            aux = 0.0
+        def sp_loss(params, batch, dropout_key):
+            del dropout_key
+            tokens = batch["image"]
+            labels = batch["label"]
+            b, s_loc = tokens.shape
+            me_s = lax.axis_index(seq_ax)
+            positions = me_s * s_loc + jnp.arange(s_loc)
+            if with_aux:  # EP path (seq axis is size 1 — guarded in registry)
+                logits, aux = apply_fn(params, tokens, positions,
+                                       return_aux=True)
+            else:
+                logits = apply_fn(params, tokens, positions)  # [b, s_loc, V]
+                aux = 0.0
 
-        # shard j receives shard (j+1)'s first target column
-        perm = [((j + 1) % n_seq, j) for j in range(n_seq)]
-        nxt = lax.ppermute(labels[:, :1], seq_ax, perm)
-        tgt = jnp.concatenate([labels[:, 1:], nxt], axis=1).astype(jnp.int32)
+            # shard j receives shard (j+1)'s first target column
+            perm = [((j + 1) % n_seq, j) for j in range(n_seq)]
+            nxt = lax.ppermute(labels[:, :1], seq_ax, perm)
+            tgt = jnp.concatenate([labels[:, 1:], nxt], axis=1).astype(jnp.int32)
 
-        s_global = s_loc * n_seq
-        w = (positions < s_global - 1).astype(jnp.float32)[None, :]
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-        correct = (jnp.argmax(logp, axis=-1) == tgt).astype(jnp.float32)
-        total = b * (s_global - 1)  # this replica's global token count
-        return (jnp.sum(nll * w) / total + aux_w * aux,
-                jnp.sum(correct * w) / total)
+            s_global = s_loc * n_seq
+            w = (positions < s_global - 1).astype(jnp.float32)[None, :]
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+            correct = (jnp.argmax(logp, axis=-1) == tgt).astype(jnp.float32)
+            total = b * (s_global - 1)  # this replica's global token count
+            return (jnp.sum(nll * w) / total + aux_w * aux,
+                    jnp.sum(correct * w) / total)
+        return sp_loss
+
+    local_loss_sp = (make_sp_loss(sharded_apply, has_aux)
+                     if sharded_apply is not None else
+                     make_sp_loss(pp_apply, False)
+                     if (pp_apply is not None and n_seq > 1) else None)
 
     def shard_fn(state: TrainState, batch: dict,
                  measured_ms: jax.Array) -> tuple[TrainState, dict]:
@@ -301,7 +309,7 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         dkey = prng.replica_key(state.root_key, "dropout", step, me)
         local_params = jax.tree.map(
             lambda x: lax.pcast(x, grad_axes, to="varying"), state.params)
-        if sharded_apply is not None:
+        if local_loss_sp is not None:  # DP×SP×TP, or PP×SP
             (loss_p, acc_p), grads = jax.value_and_grad(
                 local_loss_sp, has_aux=True)(local_params, batch, dkey)
             # reassemble the full-sequence gradient / metrics
@@ -435,7 +443,7 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         "updates_applied": P(), "step_times_ms": P(), "flags": P(),
         "applied": P(),
     }
-    batch_spec = P(axis, seq_ax) if sharded_apply else P(axis)
+    batch_spec = P(axis, seq_ax) if n_seq > 1 else P(axis)
     sharded = jax.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(state_specs, batch_spec, P(axis)),

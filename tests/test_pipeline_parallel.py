@@ -105,14 +105,38 @@ def test_pp_step_matches_dense_update(n_replicas, n_stage, n_model,
                                    rtol=3e-4, atol=3e-5)
 
 
-def test_pp_rejects_sp_combo():
-    """PP×SP remains an explicit refusal (the one composition gap —
-    recorded in PARITY.md), while PP×TP now builds."""
-    cfg = _cfg()
-    topo = make_topology(MeshConfig(num_replicas=2, seq_parallelism=2,
-                                    pipeline_parallelism=2))
-    with pytest.raises(ValueError, match="seq_parallelism=1"):
-        build_train_step(get_model(cfg.model), cfg, topo, constant(LR))
+@pytest.mark.parametrize("n_replicas,n_stage,n_seq,microbatches", [
+    (2, 2, 2, 2),   # DP × PP × SP (ring attention inside the pipeline)
+    (1, 2, 4, 2),   # PP × wide SP
+])
+def test_pp_sp_step_matches_dense_update(n_replicas, n_stage, n_seq,
+                                         microbatches):
+    """PP×SP: the seq axis shards tokens through the pipeline stages
+    (ring attention collectives run lockstep inside the pipeline scan)
+    and the partial SP loss psums back to the dense loss exactly."""
+    cfg = _cfg(n_replicas=n_replicas)
+    cfg = cfg.override({"mesh.num_replicas": n_replicas,
+                        "mesh.pipeline_parallelism": n_stage,
+                        "mesh.seq_parallelism": n_seq,
+                        "mesh.pipeline_microbatches": microbatches})
+    batch = _tokens(cfg)
+    want_loss, want_params = _dense_update(cfg, batch)
+
+    topo = make_topology(cfg.mesh)
+    model = get_model(cfg.model)
+    specs = state_partition_specs(model, cfg, topo)
+    state = topo.device_put_state(init_train_state(model, cfg, topo), specs)
+    step_fn = build_train_step(model, cfg, topo, constant(LR))
+    state, metrics = step_fn(state, topo.device_put_batch(batch,
+                                                          seq_sharded=True))
+
+    np.testing.assert_allclose(float(metrics["loss"]), float(want_loss),
+                               rtol=2e-5, atol=2e-5)
+    got = jax.device_get(state.params)
+    want_stacked = transformer.stack_block_params(want_params)
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want_stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-5)
 
 
 def test_trainer_end_to_end_dp_pp(tmp_train_dir):

@@ -50,3 +50,42 @@ def test_transformer_quorum_mode(tmp_train_dir):
     t = Trainer(cfg)
     s = t.run()
     assert s["last_metrics"]["num_contributors"] == 5.0
+
+
+def test_remat_matches_dense_exactly(topo8):
+    """jax.checkpoint is a pure memory/FLOPs trade: with remat on, the
+    loss and one-step update must be bit-comparable to the non-remat
+    model (same graph numerics, recomputed not stored)."""
+    import jax
+    import numpy as np
+
+    from conftest import base_config
+    from distributedmnist_tpu.models.registry import get_model
+    from distributedmnist_tpu.parallel.api import (build_train_step,
+                                                   init_train_state)
+    from distributedmnist_tpu.train.lr_schedule import constant
+
+    results = {}
+    for remat in (False, True):
+        cfg = base_config(
+            data={"dataset": "synthetic_lm", "batch_size": 8},
+            model={"name": "transformer", "compute_dtype": "float32",
+                   "seq_len": 16, "model_dim": 32, "num_heads": 4,
+                   "num_layers": 2, "vocab_size": 37,
+                   "attention_impl": "dense", "remat": remat},
+            sync={"mode": "sync", "straggler_profile": "none"},
+        )
+        cfg = cfg.override({"mesh.num_replicas": 8})
+        model = get_model(cfg.model)
+        state = topo8.device_put_replicated(init_train_state(model, cfg))
+        step_fn = build_train_step(model, cfg, topo8, constant(0.1))
+        toks = jax.random.randint(jax.random.PRNGKey(3), (8, 16), 0, 37)
+        state, metrics = step_fn(
+            state, topo8.device_put_batch({"image": toks, "label": toks}))
+        results[remat] = (float(metrics["loss"]),
+                          jax.tree.leaves(jax.device_get(state.params)))
+    np.testing.assert_allclose(results[False][0], results[True][0],
+                               rtol=1e-6)
+    for a, b in zip(results[False][1], results[True][1]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
