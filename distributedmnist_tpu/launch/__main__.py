@@ -97,6 +97,15 @@ def _devices(_args) -> None:
 
 
 def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "campaign":
+        # pre-dispatch: the campaign owns its own flags (REMAINDER
+        # cannot capture leading options), and its 8-device mesh must
+        # be forced before any backend init
+        from ..core.mesh import simulate_devices
+        simulate_devices(8)
+        from .campaign import main as campaign_main
+        return campaign_main(argv[1:])
     p = argparse.ArgumentParser(prog="distributedmnist_tpu.launch")
     sub = p.add_subparsers(dest="cmd", required=True)
 
@@ -137,6 +146,10 @@ def main(argv=None) -> None:
                         add_help=False)
     pp.add_argument("rest", nargs=argparse.REMAINDER)
     pp.set_defaults(fn=_pod)
+
+    sub.add_parser("campaign",
+                   help="run the full experiment campaign grid "
+                        "(options: see `campaign --help`)")
 
     args = p.parse_args(argv)
     args.fn(args)
