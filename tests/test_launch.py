@@ -92,6 +92,25 @@ def test_repo_sweep_configs_all_parse():
     assert "mnist_99" in names  # the one-command 99% repro config
 
 
+def test_campaign_groups_resolve_to_configs():
+    """Every name the campaign driver would run must resolve to a
+    loadable config — including repro_mnist99, whose config lives in
+    configs/repro/ (the same fallback run_group applies)."""
+    from pathlib import Path
+    from distributedmnist_tpu.core.config import ExperimentConfig
+    from distributedmnist_tpu.launch.campaign import (EVALUATED_RUNS, GROUPS,
+                                                      resolve_config_path)
+    root = Path(__file__).resolve().parent.parent / "configs"
+    all_names = set()
+    for names in GROUPS.values():
+        for name in names:
+            cfg = ExperimentConfig.from_file(resolve_config_path(root, name))
+            assert cfg.name == name
+            all_names.add(name)
+    assert "mnist_99" in all_names
+    assert EVALUATED_RUNS <= all_names  # evaluator targets are real runs
+
+
 def test_cli_devices(capsys):
     from distributedmnist_tpu.launch.__main__ import main
     main(["devices"])
