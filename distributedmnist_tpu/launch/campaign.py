@@ -53,7 +53,19 @@ GROUPS = {
     # the same harness, with the evaluator oracle live against it — the
     # reference's headline result (99%+ MNIST, src/nn_eval.py:95-103)
     "repro_mnist99": ["mnist_99"],
+    # Experiment A at the reference's TRUE topology: 50 workers,
+    # replicas_to_aggregate ∈ {1,10,20,30,40,49,50}
+    # (cfg/50_workers/*_aggregate_sync:10). The configs force a
+    # 50-virtual-device mesh (mesh.simulate_devices), so this group is
+    # NOT in the default run — launch it on its own:
+    #   python run_campaign.py --groups quorum50
+    "quorum50": [f"quorum50_k{k}_of_50" for k in (1, 10, 20, 30, 40, 49, 50)],
 }
+
+# Groups a plain `python run_campaign.py` runs. quorum50 re-forces the
+# simulated platform to 50 devices mid-process, which would leave the
+# remaining 8-device groups on the wrong mesh — it runs standalone.
+DEFAULT_GROUPS = [g for g in GROUPS if g != "quorum50"]
 
 # CPU-budget scale-downs, recorded verbatim into each result record.
 # (Note: the quorum/interval configs themselves carry the reference's
@@ -186,9 +198,19 @@ def main(argv=None, root: Path | None = None) -> int:
     ap.add_argument("--configs", default=str(root / "configs"))
     ap.add_argument("--data-cache", default=str(root / "data_cache"))
     ap.add_argument("--quick", action="store_true")
-    ap.add_argument("--groups", default=",".join(GROUPS))
+    ap.add_argument("--groups", default=",".join(DEFAULT_GROUPS))
     ap.add_argument("--finalize-only", action="store_true")
     args = ap.parse_args(argv)
+    groups = args.groups.split(",")
+    unknown = [g for g in groups if g not in GROUPS]
+    if unknown:
+        ap.error(f"unknown groups {unknown}; choose from {sorted(GROUPS)}")
+    if "quorum50" in groups and len(groups) > 1:
+        # the 50-device configs tear down and re-force the simulated
+        # platform; any 8-device group in the same process would then
+        # silently run (and record) 50-way experiments
+        ap.error("quorum50 re-forces the mesh to 50 devices and must run "
+                 "in its own process: --groups quorum50")
     results_dir = Path(args.results)
     results_dir.mkdir(parents=True, exist_ok=True)
     if args.finalize_only:
@@ -203,7 +225,7 @@ def main(argv=None, root: Path | None = None) -> int:
 
     t0 = time.time()
     all_records = {}
-    for group in args.groups.split(","):
+    for group in groups:
         all_records[group] = run_group(group, GROUPS[group], results_dir,
                                        Path(args.configs), data_dir,
                                        args.quick)
