@@ -104,6 +104,43 @@ def simulate_devices(n: int) -> None:
         pass  # backend already initialized; XLA_FLAGS path applies
 
 
+_ambient_mesh: tuple[int, str] | None = None  # (device_count, platform)
+
+
+def ensure_mesh(simulate: int) -> None:
+    """Make the process's device set match what a config expects.
+
+    ``simulate > 0`` forces that many virtual CPU devices (tearing down
+    a previously initialized backend if the count differs);
+    ``simulate == 0`` means "the ambient devices" — captured at this
+    helper's first call — and RESTORES them if a previous config left a
+    different simulated platform behind.
+
+    This is the guard that makes mixed sweeps safe: without it, a
+    ``launch sweep`` over a directory where one config forces a
+    50-device mesh (configs/quorum50_*) would silently run every
+    subsequent ambient-mesh config 50-wide under its 8-wide name.
+    Restoration is only possible when the ambient platform was CPU
+    (re-forcing a torn-down accelerator backend is not supported) —
+    otherwise this raises rather than continuing on the wrong mesh.
+    """
+    global _ambient_mesh
+    if _ambient_mesh is None:
+        _ambient_mesh = (len(jax.devices()), jax.default_backend())
+    want, platform = ((simulate, "cpu") if simulate > 0 else _ambient_mesh)
+    if len(jax.devices()) == want and jax.default_backend() == platform:
+        return
+    if platform != "cpu":
+        raise RuntimeError(
+            f"cannot restore the ambient {platform} backend after a "
+            "simulated-mesh config ran in this process; run "
+            "simulated-mesh configs (mesh.simulate_devices > 0) in their "
+            "own process")
+    import jax.extend.backend as jeb
+    jeb.clear_backends()
+    simulate_devices(want)
+
+
 @dataclasses.dataclass(frozen=True)
 class Topology:
     """Resolved topology: the mesh plus canonical shardings."""

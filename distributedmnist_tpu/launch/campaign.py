@@ -56,15 +56,15 @@ GROUPS = {
     # Experiment A at the reference's TRUE topology: 50 workers,
     # replicas_to_aggregate ∈ {1,10,20,30,40,49,50}
     # (cfg/50_workers/*_aggregate_sync:10). The configs force a
-    # 50-virtual-device mesh (mesh.simulate_devices), so this group is
-    # NOT in the default run — launch it on its own:
+    # 50-virtual-device mesh (mesh.simulate_devices; run_experiment's
+    # ensure_mesh restores the ambient mesh afterwards):
     #   python run_campaign.py --groups quorum50
     "quorum50": [f"quorum50_k{k}_of_50" for k in (1, 10, 20, 30, 40, 49, 50)],
 }
 
-# Groups a plain `python run_campaign.py` runs. quorum50 re-forces the
-# simulated platform to 50 devices mid-process, which would leave the
-# remaining 8-device groups on the wrong mesh — it runs standalone.
+# Groups a plain `python run_campaign.py` runs. quorum50 is excluded on
+# wall-clock grounds only (7 more 300-step runs at 50-way SPMD, hours
+# on one core) — launch it separately when the grid is wanted.
 DEFAULT_GROUPS = [g for g in GROUPS if g != "quorum50"]
 
 # CPU-budget scale-downs, recorded verbatim into each result record.
@@ -79,6 +79,11 @@ OVERRIDES = {
     # src/distributed_train.py:76-77) so the live evaluator sees a
     # stream of checkpoints, not just the final one
     "quorum_k8_of_8": {"train.save_interval_secs": 15.0},
+    # same, and also: at this run's CPU step rate the config's step-based
+    # cadence (save_interval_steps=500 ≈ 13 min) outlives the
+    # evaluator's 600 s first-checkpoint timeout — wall-clock saves keep
+    # the oracle fed from the start
+    "mnist_99": {"train.save_interval_secs": 60.0},
 }
 
 EVALUATED_RUN = "quorum_k8_of_8"  # kept for callers that import it
@@ -205,12 +210,6 @@ def main(argv=None, root: Path | None = None) -> int:
     unknown = [g for g in groups if g not in GROUPS]
     if unknown:
         ap.error(f"unknown groups {unknown}; choose from {sorted(GROUPS)}")
-    if "quorum50" in groups and len(groups) > 1:
-        # the 50-device configs tear down and re-force the simulated
-        # platform; any 8-device group in the same process would then
-        # silently run (and record) 50-way experiments
-        ap.error("quorum50 re-forces the mesh to 50 devices and must run "
-                 "in its own process: --groups quorum50")
     results_dir = Path(args.results)
     results_dir.mkdir(parents=True, exist_ok=True)
     if args.finalize_only:

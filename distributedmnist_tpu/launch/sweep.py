@@ -34,7 +34,12 @@ def run_experiment(cfg: ExperimentConfig, results_dir: str | Path,
     ≙ run_tf_and_download_files + stats parsing
     (tools/benchmark.py:36-163) collapsed into a function call.
     """
+    from ..core.mesh import ensure_mesh
     from ..train.loop import Trainer  # deferred: heavy jax import chain
+
+    # force/restore the device set this config expects, so a sweep can
+    # mix simulated-mesh configs (quorum50) with ambient-mesh ones
+    ensure_mesh(cfg.mesh.simulate_devices)
 
     results_dir = Path(results_dir) / cfg.name
     results_dir.mkdir(parents=True, exist_ok=True)

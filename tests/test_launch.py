@@ -92,6 +92,41 @@ def test_repo_sweep_configs_all_parse():
     assert "mnist_99" in names  # the one-command 99% repro config
 
 
+def test_sweep_restores_ambient_mesh(tmp_path):
+    """A sweep mixing a simulated-mesh config with ambient-mesh ones
+    must run each on ITS mesh: the 4-device config forces 4 virtual
+    devices, and the following plain config gets the ambient 8 back
+    (ensure_mesh). Without the restore, every config after a
+    quorum50-style entry silently runs (and records) wide experiments
+    under its narrow name. Subprocess: clear_backends would invalidate
+    this session's device handles."""
+    import subprocess
+    import sys
+    script = f"""
+import json
+from distributedmnist_tpu.core.mesh import simulate_devices
+simulate_devices(8)  # the ambient mesh (what conftest does)
+from distributedmnist_tpu.core.config import ExperimentConfig
+from distributedmnist_tpu.launch.sweep import run_sweep
+base = {{"data": {{"dataset": "synthetic", "batch_size": 64,
+                   "synthetic_train_size": 256, "synthetic_test_size": 128,
+                   "use_native_pipeline": False}},
+         "model": {{"compute_dtype": "float32"}},
+         "train": {{"max_steps": 2, "log_every_steps": 1,
+                    "save_interval_steps": 0, "save_results_period": 0}}}}
+cfgs = [ExperimentConfig.from_dict(dict(base, name="sim4",
+                                        mesh={{"simulate_devices": 4}})),
+        ExperimentConfig.from_dict(dict(base, name="ambient"))]
+recs = run_sweep(cfgs, r"{tmp_path}")
+print(json.dumps([[r["name"], r["num_replicas"]] for r in recs]))
+"""
+    out = subprocess.run([sys.executable, "-c", script], capture_output=True,
+                         text=True, timeout=420)
+    assert out.returncode == 0, out.stderr[-2000:]
+    got = json.loads(out.stdout.strip().splitlines()[-1])
+    assert got == [["sim4", 4], ["ambient", 8]], got
+
+
 def test_campaign_groups_resolve_to_configs():
     """Every name the campaign driver would run must resolve to a
     loadable config — including repro_mnist99, whose config lives in
