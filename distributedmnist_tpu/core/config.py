@@ -79,9 +79,9 @@ class ModelConfig:
     # (all-to-all head scatter; needs num_heads % seq_parallelism == 0,
     # composes with the flash kernel).
     sp_attention: str = "ring"
-    # Mixture-of-experts FFNs (transformer): 0 = dense MLP. With
-    # mesh.model_parallelism > 1 the model axis carries the experts
-    # (expert parallelism) instead of attention heads.
+    # Mixture-of-experts FFNs (transformer): 0 = dense MLP. Experts
+    # shard over mesh.expert_parallelism (the 'expert' axis); composes
+    # with mesh.model_parallelism (TP on heads + every expert's FFN).
     num_experts: int = 0
     expert_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
@@ -158,6 +158,10 @@ class MeshConfig:
     # GPipe-style layer pipelining over the 'stage' axis.
     pipeline_parallelism: int = 1
     pipeline_microbatches: int = 4
+    # Mixture-of-experts expert sharding over the 'expert' axis;
+    # composes with model_parallelism (TP inside every expert's FFN and
+    # the attention heads).
+    expert_parallelism: int = 1
     # >0: force an N-virtual-CPU-device platform before backend init —
     # the mock distributed backend (SURVEY §4) reachable from the CLI.
     simulate_devices: int = 0
@@ -165,6 +169,7 @@ class MeshConfig:
     model_axis: str = "model"
     seq_axis: str = "seq"
     stage_axis: str = "stage"
+    expert_axis: str = "expert"
 
 
 @dataclass(frozen=True)

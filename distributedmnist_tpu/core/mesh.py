@@ -150,6 +150,7 @@ class Topology:
     model_axis: str
     seq_axis: str
     stage_axis: str
+    expert_axis: str = "expert"
 
     @property
     def num_replicas(self) -> int:
@@ -256,10 +257,11 @@ def make_topology(cfg: MeshConfig | None = None,
                   devices: Sequence[jax.Device] | None = None) -> Topology:
     """Build the device mesh.
 
-    Axes: (replica, model, seq, stage). Data parallelism rides
+    Axes: (replica, model, seq, stage, expert). Data parallelism rides
     ``replica``; ``model`` carries Megatron tensor parallelism, ``seq``
     ring/all-to-all sequence parallelism, ``stage`` GPipe layer
-    pipelining. Unused axes default to size 1.
+    pipelining, ``expert`` MoE expert sharding. Unused axes default to
+    size 1.
     """
     cfg = cfg or MeshConfig()
     if (devices is None and cfg.simulate_devices > 0
@@ -273,22 +275,24 @@ def make_topology(cfg: MeshConfig | None = None,
     devs = list(devices if devices is not None else jax.devices())
     mp, sp = max(1, cfg.model_parallelism), max(1, cfg.seq_parallelism)
     pp = max(1, cfg.pipeline_parallelism)
+    ep = max(1, cfg.expert_parallelism)
     n = cfg.num_replicas
     if n == -1:
-        n = len(devs) // (mp * sp * pp)
-    want = n * mp * sp * pp
+        n = len(devs) // (mp * sp * pp * ep)
+    want = n * mp * sp * pp * ep
     if want > len(devs):
         raise ValueError(
             f"mesh needs {want} devices (replica={n} × model={mp} × seq={sp} "
-            f"× stage={pp}) but only {len(devs)} are visible")
-    grid = np.array(devs[:want]).reshape(n, mp, sp, pp)
+            f"× stage={pp} × expert={ep}) but only {len(devs)} are visible")
+    grid = np.array(devs[:want]).reshape(n, mp, sp, pp, ep)
     mesh = Mesh(grid, (cfg.replica_axis, cfg.model_axis, cfg.seq_axis,
-                       cfg.stage_axis))
+                       cfg.stage_axis, cfg.expert_axis))
     return Topology(mesh=mesh,
                     replica_axis=cfg.replica_axis,
                     model_axis=cfg.model_axis,
                     seq_axis=cfg.seq_axis,
-                    stage_axis=cfg.stage_axis)
+                    stage_axis=cfg.stage_axis,
+                    expert_axis=cfg.expert_axis)
 
 
 def make_seq_topology(n_seq: int, devices: Sequence[jax.Device] | None = None) -> Topology:

@@ -67,16 +67,17 @@ class Model:
     input_dtype: Any = jnp.float32
     eval_metrics: Callable[..., tuple] = classification_eval_metrics
     # Sharded-execution support (long-context models only):
-    # factory(seq_axis, model_axis) -> apply(params, tokens_local,
-    # positions_local) -> logits_local, run inside shard_map. Either
-    # axis may be None (unsharded); with seq_axis the sequence dim is
-    # sharded (ring/all-to-all attention), with model_axis params are
-    # tensor-parallel per ``tp_param_specs``.
-    sharded_apply_factory: (Callable[[str | None, str | None],
+    # factory(seq_axis, model_axis, expert_axis=None) -> apply(params,
+    # tokens_local, positions_local) -> logits_local, run inside
+    # shard_map. Any axis may be None (unsharded); with seq_axis the
+    # sequence dim is sharded (ring/all-to-all attention), with
+    # model_axis params are tensor-parallel per ``tp_param_specs``,
+    # with expert_axis MoE experts are sharded over it.
+    sharded_apply_factory: (Callable[...,
                                      Callable[..., jax.Array]] | None) = None
-    # factory(model_axis) -> params-shaped pytree of PartitionSpec for
-    # tensor-parallel parameter placement.
-    tp_param_specs: Callable[[str], Any] | None = None
+    # factory(model_axis, expert_axis=None) -> params-shaped pytree of
+    # PartitionSpec for tensor-/expert-parallel parameter placement.
+    tp_param_specs: Callable[..., Any] | None = None
     # Pipeline-parallel support: pp_transform restacks init params into
     # the layer-stacked layout; pp_param_specs(stage_axis) are its
     # placement specs; pp_apply_factory(stage_axis, num_microbatches)
@@ -212,28 +213,29 @@ def _transformer(cfg: ModelConfig) -> Model:
             return sharded_attn
         raise ValueError(f"unknown sp_attention {cfg.sp_attention!r}")
 
-    def sharded_apply_factory(seq_axis: str | None, model_axis: str | None):
-        """Sharded apply for the DP×SP×TP train step: tokens arrive as
-        [b, seq_local] slices; attention crosses seq shards via the
-        configured strategy; params may be tensor-parallel shards."""
+    def sharded_apply_factory(seq_axis: str | None, model_axis: str | None,
+                              expert_axis: str | None = None):
+        """Sharded apply for the DP×SP×TP×EP train step: tokens arrive
+        as [b, seq_local] slices; attention crosses seq shards via the
+        configured strategy; params may be tensor-parallel and/or
+        expert-parallel shards."""
         sharded_attn = make_seq_attn(seq_axis)
 
         if moe and seq_axis is not None:
             raise ValueError("mixture-of-experts does not yet compose with "
                              "sequence parallelism (capacity would become "
                              "shard-local)")
-        # with MoE, the model axis carries EXPERTS (expert parallelism),
-        # not attention heads
-        tp_axis = None if moe else model_axis
-        ep_axis = model_axis if moe else None
+        if expert_axis is not None and not moe:
+            raise ValueError("mesh has expert parallelism but the model has "
+                             "no experts (model.num_experts == 0)")
 
         def apply_sharded(params, tokens, positions, return_aux=False):
             return transformer.apply(params, tokens, num_heads=cfg.num_heads,
                                      attention_fn=sharded_attn,
                                      positions=positions,
                                      compute_dtype=compute_dtype,
-                                     model_axis=tp_axis,
-                                     expert_axis=ep_axis,
+                                     model_axis=model_axis,
+                                     expert_axis=expert_axis,
                                      num_experts=cfg.num_experts,
                                      capacity_factor=cfg.expert_capacity_factor,
                                      remat=cfg.remat,
@@ -265,8 +267,9 @@ def _transformer(cfg: ModelConfig) -> Model:
                  eval_metrics=lm_eval_metrics,
                  sharded_apply_factory=sharded_apply_factory,
                  has_aux=moe, aux_weight=cfg.moe_aux_weight,
-                 tp_param_specs=lambda axis: transformer.param_partition_specs(
-                     cfg.num_layers, axis, cfg.num_experts),
+                 tp_param_specs=lambda axis, expert_axis=None:
+                     transformer.param_partition_specs(
+                         cfg.num_layers, axis, cfg.num_experts, expert_axis),
                  pp_transform=transformer.stack_block_params,
                  pp_param_specs=transformer.pp_param_partition_specs,
                  pp_apply_factory=pp_apply_factory)

@@ -70,33 +70,40 @@ def init(key: jax.Array, vocab_size: int = 256, model_dim: int = 128,
     return params
 
 
-def param_partition_specs(num_layers: int, model_axis: str,
-                          num_experts: int = 0) -> Params:
-    """Model-axis placement.
+def param_partition_specs(num_layers: int, model_axis: str | None,
+                          num_experts: int = 0,
+                          expert_axis: str | None = None) -> Params:
+    """Mesh placement for the flat (per-layer list) layout.
 
-    Dense FFN → Megatron TP layout: qkv & MLP-in column-parallel
+    ``model_axis`` (TP) → Megatron layout: qkv & MLP-in column-parallel
     (output dim sharded), their consumers wo & MLP-out row-parallel
     (input dim sharded → one psum each per block); embeddings and norms
     replicated.
 
-    MoE (num_experts > 0) → expert parallelism: the axis carries the
-    EXPERT dim of w1/w2; attention and the router stay replicated."""
+    ``expert_axis`` (EP, num_experts > 0) → w1/w2's leading EXPERT dim
+    sharded; the router stays replicated. The two compose: EP picks
+    which experts a rank holds, TP splits each expert's hidden dim (and
+    the attention heads) across the model axis."""
     P = PartitionSpec
+    m = model_axis  # None → replicated on the TP dims
     if num_experts > 0:
+        e = expert_axis
         blk = {
-            "ln1": {"scale": P()}, "wqkv": P(), "wo": P(),
+            "ln1": {"scale": P()},
+            "wqkv": P(None, None, m),
+            "wo": P(m, None),
             "ln2": {"scale": P()}, "router": P(),
-            "w1": P(model_axis, None, None),
-            "w2": P(model_axis, None, None),
+            "w1": P(e, None, m),
+            "w2": P(e, m, None),
         }
     else:
         blk = {
             "ln1": {"scale": P()},
-            "wqkv": P(None, None, model_axis),
-            "wo": P(model_axis, None),
+            "wqkv": P(None, None, m),
+            "wo": P(m, None),
             "ln2": {"scale": P()},
-            "w1": P(None, model_axis),
-            "w2": P(model_axis, None),
+            "w1": P(None, m),
+            "w2": P(m, None),
         }
     return {"embed": P(), "pos": P(), "blocks": [dict(blk) for _ in range(num_layers)],
             "final_norm": {"scale": P()}}
@@ -128,8 +135,10 @@ def apply(params: Params, tokens: jax.Array, *, num_heads: int = 4,
     (and any loss) are identical on every TP rank.
 
     ``expert_axis``/``num_experts``: mixture-of-experts FFNs with the
-    experts sharded over the axis (expert parallelism — mutually
-    exclusive with ``model_axis``, which carries heads).
+    experts sharded over the axis (expert parallelism). Composes with
+    ``model_axis``: heads and every expert's hidden dim are
+    tensor-parallel over the model axis, experts over the expert axis,
+    with one fused psum per MoE block covering both.
     ``return_aux``: also return the summed load-balancing aux loss.
     """
     attn = attention_fn or local_self_attention
@@ -193,7 +202,8 @@ def _apply_block(x: jax.Array, blk: Params, *, h_local: int, hd: int,
         mlp, aux = moe_ffn(h, blk["router"], blk["w1"], blk["w2"],
                            num_experts=num_experts,
                            capacity_factor=capacity_factor,
-                           expert_axis=expert_axis)
+                           expert_axis=expert_axis,
+                           tp_axis=model_axis)
     else:
         mlp = jax.nn.relu(h @ blk["w1"]) @ blk["w2"]
         aux = jnp.zeros((), jnp.float32)

@@ -28,14 +28,20 @@ from jax import lax
 
 def moe_ffn(x: jax.Array, router_w: jax.Array, w1: jax.Array, w2: jax.Array,
             *, num_experts: int, capacity_factor: float = 1.25,
-            expert_axis: str | None = None) -> tuple[jax.Array, jax.Array]:
+            expert_axis: str | None = None,
+            tp_axis: str | None = None) -> tuple[jax.Array, jax.Array]:
     """Top-1 routed expert FFN.
 
-    Args (inside shard_map when ``expert_axis`` is set):
-      x: [batch, seq, d] activations (replicated over the expert axis).
+    Args (inside shard_map when ``expert_axis``/``tp_axis`` are set):
+      x: [batch, seq, d] activations (replicated over both axes).
       router_w: [d, E] routing weights (replicated).
-      w1: [E_local, d, ff], w2: [E_local, ff, d] — THIS rank's expert
-        slice (E_local = E / axis_size; E_local = E when unsharded).
+      w1: [E_local, d, ff_local], w2: [E_local, ff_local, d] — THIS
+        rank's expert slice (E_local = E / expert-axis size) and, with
+        ``tp_axis``, its Megatron column/row slice of every expert's
+        hidden dim (ff_local = ff / tp-axis size). The two shardings
+        compose: EP picks which experts live here, TP splits each
+        expert's FFN across the model axis, and ONE fused psum over
+        both axes reassembles the combined output.
       num_experts: E (global).
       capacity_factor: per-expert capacity = ceil(cf · tokens / E);
         overflow tokens pass through the residual unchanged (their
@@ -88,8 +94,12 @@ def moe_ffn(x: jax.Array, router_w: jax.Array, w1: jax.Array, w2: jax.Array,
     _, expert_out = lax.scan(one_expert, None,
                              (expert_in, w1, w2))     # [E_local, C, d]
     out = jnp.einsum("tec,ecd->td", combine.astype(dtype), expert_out)
-    if expert_axis is not None:
-        out = lax.psum(out, expert_axis)
+    # One psum reassembles both decompositions: over the expert axis
+    # (each rank combined only its local experts) and the TP axis (each
+    # rank's w2 row-slice yields a partial sum of the full d).
+    reduce_axes = tuple(a for a in (expert_axis, tp_axis) if a is not None)
+    if reduce_axes:
+        out = lax.psum(out, reduce_axes)
         # (aux needs no reduction: the router is replicated, so every
         # rank computed the identical value)
     return out.reshape(b, s, d), aux.astype(jnp.float32)

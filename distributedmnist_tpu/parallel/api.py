@@ -69,18 +69,29 @@ def state_partition_specs(model: Model, cfg: ExperimentConfig,
 
     n_model = topo.mesh.shape[topo.model_axis]
     n_stage = topo.mesh.shape[topo.stage_axis]
+    n_expert = topo.mesh.shape[topo.expert_axis]
     if n_model > 1 and getattr(model, "tp_param_specs", None) is None:
         raise ValueError(f"mesh has model_parallelism={n_model} but model "
                          f"{model.name!r} has no tensor-parallel parameter "
                          "specs")
+    if n_expert > 1 and (getattr(model, "tp_param_specs", None) is None
+                         or not getattr(model, "has_aux", False)):
+        raise ValueError(f"mesh has expert_parallelism={n_expert} but model "
+                         f"{model.name!r} has no experts to shard")
     if n_stage > 1 and getattr(model, "pp_param_specs", None) is None:
         raise ValueError(f"mesh has pipeline_parallelism={n_stage} but model "
                          f"{model.name!r} has no pipeline parameter specs")
+    if n_expert > 1 and n_stage > 1:
+        raise ValueError("expert parallelism does not yet compose with "
+                         "pipeline parallelism (aux loss cannot cross the "
+                         "stage pipeline)")
     if n_stage > 1:
         pspec: Any = model.pp_param_specs(
             topo.stage_axis, topo.model_axis if n_model > 1 else None)
-    elif n_model > 1:
-        pspec = model.tp_param_specs(topo.model_axis)
+    elif n_model > 1 or n_expert > 1:
+        pspec = model.tp_param_specs(
+            topo.model_axis if n_model > 1 else None,
+            topo.expert_axis if n_expert > 1 else None)
     else:
         pspec = P_()
     has_momentum = cfg.optim.momentum > 0.0
@@ -189,12 +200,21 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
     # norms) get their stage-psum from the AD transpose of replication.
     stage_ax = topo.stage_axis
     n_stage = topo.mesh.shape[stage_ax]
-    if ((n_seq > 1 or n_model > 1) and n_stage == 1
+    # Expert parallelism: experts sharded over the expert axis; composes
+    # with TP (model axis splits heads + every expert's hidden dim).
+    expert_ax = topo.expert_axis
+    n_expert = topo.mesh.shape[expert_ax]
+    if ((n_seq > 1 or n_model > 1 or n_expert > 1) and n_stage == 1
             and getattr(model, "sharded_apply_factory", None) is None):
         raise ValueError(
             f"mesh has seq_parallelism={n_seq} / model_parallelism="
-            f"{n_model} but model {model.name!r} supports neither "
+            f"{n_model} / expert_parallelism={n_expert} but model "
+            f"{model.name!r} supports none of them "
             "(no sharded_apply_factory)")
+    if n_expert > 1 and n_stage > 1:
+        raise ValueError("expert parallelism does not yet compose with "
+                         "pipeline parallelism (aux loss cannot cross the "
+                         "stage pipeline)")
     if n_stage > 1:
         if getattr(model, "pp_apply_factory", None) is None:
             raise ValueError(f"mesh has pipeline_parallelism={n_stage} but "
@@ -210,8 +230,10 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
     else:
         pp_apply = None
     sharded_apply = (model.sharded_apply_factory(
-        seq_ax if n_seq > 1 else None, model_ax if n_model > 1 else None)
-        if (n_seq > 1 or n_model > 1) and pp_apply is None else None)
+        seq_ax if n_seq > 1 else None, model_ax if n_model > 1 else None,
+        expert_ax if n_expert > 1 else None)
+        if (n_seq > 1 or n_model > 1 or n_expert > 1) and pp_apply is None
+        else None)
     # The SP/PP loss paths do not thread a dropout key; refuse loudly
     # instead of silently training a dropout model without dropout.
     if ((sharded_apply is not None or pp_apply is not None)
@@ -474,6 +496,7 @@ def build_eval_step(model: Model, cfg: ExperimentConfig, topo: Topology):
     model_ax = topo.model_axis
     n_model = topo.mesh.shape[model_ax]
     n_stage = topo.mesh.shape[topo.stage_axis]
+    n_expert = topo.mesh.shape[topo.expert_axis]
     if n_stage > 1:
         # pipeline-parallel params: stacked layout, microbatch M=1
         # (latency is irrelevant for eval; correctness is identical)
@@ -486,16 +509,19 @@ def build_eval_step(model: Model, cfg: ExperimentConfig, topo: Topology):
 
         def run(params, images):
             return eval_pp_apply(params, images)
-    elif n_model > 1:
-        # tensor-parallel params: sharded apply (full sequence per
-        # device — eval batches are not seq-sharded), sharded in_spec
+    elif n_model > 1 or n_expert > 1:
+        # tensor-/expert-parallel params: sharded apply (full sequence
+        # per device — eval batches are not seq-sharded), sharded in_spec
         if (getattr(model, "tp_param_specs", None) is None
                 or getattr(model, "sharded_apply_factory", None) is None):
-            raise ValueError(f"mesh has model_parallelism={n_model} but "
-                             f"model {model.name!r} is not tensor-parallel "
+            raise ValueError(f"mesh has model_parallelism={n_model} / "
+                             f"expert_parallelism={n_expert} but model "
+                             f"{model.name!r} is not tensor-/expert-parallel "
                              "capable")
-        pspec: Any = model.tp_param_specs(model_ax)
-        tp_apply = model.sharded_apply_factory(None, model_ax)
+        tp_ax = model_ax if n_model > 1 else None
+        ep_ax = topo.expert_axis if n_expert > 1 else None
+        pspec: Any = model.tp_param_specs(tp_ax, ep_ax)
+        tp_apply = model.sharded_apply_factory(None, tp_ax, ep_ax)
 
         def run(params, images):
             return tp_apply(params, images, None)

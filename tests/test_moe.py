@@ -66,8 +66,8 @@ def test_ep_matches_unsharded():
     want, want_aux = moe_ffn(x, router, w1, w2, num_experts=E,
                              capacity_factor=2.0)
 
-    topo = make_topology(MeshConfig(num_replicas=1, model_parallelism=4))
-    axis = topo.model_axis
+    topo = make_topology(MeshConfig(num_replicas=1, expert_parallelism=4))
+    axis = topo.expert_axis
 
     def fn(x, router, w1, w2):
         return moe_ffn(x, router, w1, w2, num_experts=E,
@@ -76,6 +76,32 @@ def test_ep_matches_unsharded():
     got, got_aux = jax.jit(jax.shard_map(
         fn, mesh=topo.mesh,
         in_specs=(P(), P(), P(axis), P(axis)),
+        out_specs=(P(), P())))(x, router, w1, w2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(got_aux), float(want_aux), rtol=1e-6)
+
+
+def test_ep_tp_matches_unsharded():
+    """EP×TP: experts over the expert axis AND every expert's hidden
+    dim Megatron-sharded over the model axis; one fused psum over both
+    reassembles the unsharded result."""
+    router, w1, w2 = _moe_weights(jax.random.PRNGKey(8))
+    x = jax.random.normal(jax.random.PRNGKey(9), (2, 8, D))
+    want, want_aux = moe_ffn(x, router, w1, w2, num_experts=E,
+                             capacity_factor=2.0)
+
+    topo = make_topology(MeshConfig(num_replicas=1, model_parallelism=2,
+                                    expert_parallelism=2))
+    e_ax, m_ax = topo.expert_axis, topo.model_axis
+
+    def fn(x, router, w1, w2):
+        return moe_ffn(x, router, w1, w2, num_experts=E,
+                       capacity_factor=2.0, expert_axis=e_ax, tp_axis=m_ax)
+
+    got, got_aux = jax.jit(jax.shard_map(
+        fn, mesh=topo.mesh,
+        in_specs=(P(), P(), P(e_ax, None, m_ax), P(e_ax, m_ax, None)),
         out_specs=(P(), P())))(x, router, w1, w2)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
@@ -131,14 +157,20 @@ def _dense_moe_update(cfg, batch):
     return loss, jax.tree.map(lambda p, g: p - LR * g, params, grads)
 
 
-@pytest.mark.parametrize("n_replicas,n_model", [(1, 4), (2, 2)])
-def test_ep_step_matches_dense_update(n_replicas, n_model):
+@pytest.mark.parametrize("n_replicas,n_expert,n_model", [
+    (1, 4, 1),   # pure EP
+    (2, 2, 1),   # DP×EP
+    (1, 2, 2),   # EP×TP: experts AND their hidden dims sharded
+    (2, 1, 2),   # DP×TP on a MoE model (all experts on every rank)
+])
+def test_ep_step_matches_dense_update(n_replicas, n_expert, n_model):
     cfg = _cfg(n_replicas=n_replicas)
     batch = _tokens(cfg)
     want_loss, want_params = _dense_moe_update(cfg, batch)
 
     topo = make_topology(MeshConfig(num_replicas=n_replicas,
-                                    model_parallelism=n_model))
+                                    model_parallelism=n_model,
+                                    expert_parallelism=n_expert))
     model = get_model(cfg.model)
     specs = state_partition_specs(model, cfg, topo)
     state = topo.device_put_state(init_train_state(model, cfg, topo), specs)
@@ -155,9 +187,18 @@ def test_ep_step_matches_dense_update(n_replicas, n_model):
 
 def test_moe_sp_combo_rejected():
     cfg = _cfg()
-    topo = make_topology(MeshConfig(num_replicas=1, model_parallelism=2,
+    topo = make_topology(MeshConfig(num_replicas=1, expert_parallelism=2,
                                     seq_parallelism=2))
     with pytest.raises(ValueError, match="sequence parallelism"):
+        build_train_step(get_model(cfg.model), cfg, topo, constant(LR))
+
+
+def test_ep_on_dense_model_rejected():
+    """expert_parallelism on a model without experts must refuse, not
+    silently waste the axis."""
+    cfg = _cfg().override({"model.num_experts": 0})
+    topo = make_topology(MeshConfig(num_replicas=1, expert_parallelism=2))
+    with pytest.raises(ValueError, match="expert"):
         build_train_step(get_model(cfg.model), cfg, topo, constant(LR))
 
 
@@ -166,7 +207,7 @@ def test_trainer_end_to_end_ep(tmp_train_dir):
 
     cfg = _cfg(n_replicas=2)
     cfg = cfg.override({
-        "mesh.num_replicas": 2, "mesh.model_parallelism": 4,
+        "mesh.num_replicas": 2, "mesh.expert_parallelism": 4,
         "sync.mode": "quorum", "sync.num_replicas_to_aggregate": 1,
         "sync.straggler_profile": "lognormal",
         "train.max_steps": 10, "train.train_dir": tmp_train_dir,
