@@ -40,6 +40,18 @@ def _case(record: dict) -> None:
     print(json.dumps(record), file=sys.stderr)
 
 
+def _published(key: str):
+    """A ratchet anchor from BASELINE.json.published — anchored to this
+    file, not the cwd (a cwd-relative read would silently turn the
+    ratchet back into a constant 1.0)."""
+    try:
+        from pathlib import Path
+        with open(Path(__file__).parent / "BASELINE.json") as f:
+            return json.load(f).get("published", {}).get(key)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
 def _build(cfg_dict: dict, topo=None):
     from distributedmnist_tpu.core.config import ExperimentConfig
     from distributedmnist_tpu.core.mesh import make_topology
@@ -86,16 +98,7 @@ def bench_cnn_sync() -> dict:
     images_per_sec = timed * batch / dt
     per_chip = images_per_sec / n_dev
 
-    baseline = None
-    try:
-        # anchored to this file, not the cwd — a cwd-relative read
-        # would silently turn the ratchet back into a constant 1.0
-        from pathlib import Path
-        with open(Path(__file__).parent / "BASELINE.json") as f:
-            baseline = json.load(f).get("published", {}).get(
-                "images_per_sec_per_chip")
-    except (OSError, json.JSONDecodeError):
-        pass
+    baseline = _published("images_per_sec_per_chip")
     vs = per_chip / baseline if baseline else 1.0
     print(f"# devices={n_dev} global_batch={batch} steps={timed} "
           f"wall={dt:.3f}s total={images_per_sec:.0f} img/s", file=sys.stderr)
@@ -133,8 +136,10 @@ def bench_transformer_flash() -> None:
     fwd_per_token = L * (24 * d * d + 2 * S * d) + 2 * d * V
     flops = 3 * fwd_per_token * B * S * timed
     tflops = flops / dt / 1e12 / n_dev
+    anchor = _published("transformer_flash_tflops_per_chip")
     _case({"metric": "transformer_flash_train_tflops_per_chip",
            "value": round(tflops, 2), "unit": "TFLOP/s/chip",
+           "vs_baseline": round(tflops / anchor, 3) if anchor else 1.0,
            "detail": {"dims": {"d": d, "L": L, "H": H, "S": S, "V": V,
                                "B": B},
                       "steps_per_sec": round(timed / dt, 3),

@@ -223,18 +223,12 @@ def main(argv=None, root: Path | None = None) -> int:
     logger.info("idx fixtures ready under %s", data_dir)
 
     t0 = time.time()
-    all_records = {}
     for group in groups:
-        all_records[group] = run_group(group, GROUPS[group], results_dir,
-                                       Path(args.configs), data_dir,
-                                       args.quick)
-    (results_dir / "campaign_summary.json").write_text(json.dumps({
-        "wall_seconds": time.time() - t0,
-        "groups": {g: [{k: r.get(k) for k in ("name", "test_accuracy",
-                                              "examples_per_sec",
-                                              "updates_applied")}
-                       for r in recs] for g, recs in all_records.items()},
-    }, indent=2))
-    prune_heavy_artifacts(results_dir)
+        run_group(group, GROUPS[group], results_dir, Path(args.configs),
+                  data_dir, args.quick)
+    # Rebuild the summary from EVERYTHING on disk (not just the groups
+    # this invocation ran) — a partial run, e.g. --groups repro_mnist99,
+    # must merge into, not clobber, the committed campaign summary.
+    finalize(results_dir)
     logger.info("campaign complete in %.0fs", time.time() - t0)
     return 0
