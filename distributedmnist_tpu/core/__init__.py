@@ -1,12 +1,14 @@
 from .config import (ConfigError, DataConfig, EvalConfig, ExperimentConfig,
                      MeshConfig, ModelConfig, OptimConfig, SyncConfig,
                      TrainConfig, parse_cli_overrides)
-from .mesh import Topology, initialize_distributed, make_seq_topology, make_topology, simulate_devices
+from .mesh import (Topology, ensure_mesh, initialize_distributed,
+                   make_seq_topology, make_topology, simulate_devices)
 from . import log, prng
 
 __all__ = [
     "ConfigError", "DataConfig", "EvalConfig", "ExperimentConfig",
     "MeshConfig", "ModelConfig", "OptimConfig", "SyncConfig", "TrainConfig",
-    "parse_cli_overrides", "Topology", "initialize_distributed",
-    "make_seq_topology", "make_topology", "simulate_devices", "log", "prng",
+    "parse_cli_overrides", "Topology", "ensure_mesh",
+    "initialize_distributed", "make_seq_topology", "make_topology",
+    "simulate_devices", "log", "prng",
 ]

@@ -60,12 +60,19 @@ GROUPS = {
     # ensure_mesh restores the ambient mesh afterwards):
     #   python run_campaign.py --groups quorum50
     "quorum50": [f"quorum50_k{k}_of_50" for k in (1, 10, 20, 30, 40, 49, 50)],
+    # Experiment C at the true topology: the four worker-time CDF
+    # profiles on the 50-device mesh (full-barrier mode, per-replica
+    # timing all-gather at the reference's actual worker count)
+    "cdf50": ["cdf50_uniform", "cdf50_lognormal_mild",
+              "cdf50_lognormal_heavy", "cdf50_spike"],
 }
 
-# Groups a plain `python run_campaign.py` runs. quorum50 is excluded on
-# wall-clock grounds only (7 more 300-step runs at 50-way SPMD, hours
-# on one core) — launch it separately when the grid is wanted.
-DEFAULT_GROUPS = [g for g in GROUPS if g != "quorum50"]
+# Groups a plain `python run_campaign.py` runs. The 50-device groups
+# are excluded on wall-clock grounds only (300-step runs at 50-way
+# SPMD, hours on one core) — launch them separately:
+#   python run_campaign.py --groups quorum50
+#   python run_campaign.py --groups cdf50
+DEFAULT_GROUPS = [g for g in GROUPS if g not in ("quorum50", "cdf50")]
 
 # CPU-budget scale-downs, recorded verbatim into each result record.
 # (Note: the quorum/interval configs themselves carry the reference's
@@ -84,6 +91,12 @@ OVERRIDES = {
     # evaluator's 600 s first-checkpoint timeout — wall-clock saves keep
     # the oracle fed from the start
     "mnist_99": {"train.save_interval_secs": 60.0},
+    # cdf50 keeps the cdf grid's per-replica batch (128 → global 6400
+    # over 50 replicas) so the timing CDFs are comparable; the step
+    # budget is what yields to the 1-core clock — 100 steps is 100
+    # timing samples per replica, plenty for the percentile curves
+    **{f"cdf50_{p}": {"train.max_steps": 100}
+       for p in ("uniform", "lognormal_mild", "lognormal_heavy", "spike")},
 }
 
 EVALUATED_RUN = "quorum_k8_of_8"  # kept for callers that import it

@@ -127,6 +127,29 @@ print(json.dumps([[r["name"], r["num_replicas"]] for r in recs]))
     assert got == [["sim4", 4], ["ambient", 8]], got
 
 
+def test_ensure_mesh_noop_and_nonrestorable():
+    """ensure_mesh: matching device set → no backend teardown (device
+    objects stay valid); ambient-non-CPU + mismatch → loud error, never
+    a silent wrong-mesh run."""
+    import jax
+    from distributedmnist_tpu.core import mesh as mesh_mod
+
+    devs_before = jax.devices()
+    mesh_mod.ensure_mesh(8)   # conftest mesh is already 8 CPU devices
+    mesh_mod.ensure_mesh(0)   # ambient == current → noop
+    assert jax.devices() == devs_before  # no clear_backends happened
+
+    saved = mesh_mod._ambient_mesh
+    try:
+        # simulate a process whose ambient backend was a real TPU: a
+        # restore to ambient cannot re-force an accelerator
+        mesh_mod._ambient_mesh = (1, "tpu")
+        with pytest.raises(RuntimeError, match="own process"):
+            mesh_mod.ensure_mesh(0)
+    finally:
+        mesh_mod._ambient_mesh = saved
+
+
 def test_campaign_groups_resolve_to_configs():
     """Every name the campaign driver would run must resolve to a
     loadable config — including repro_mnist99, whose config lives in
