@@ -26,6 +26,7 @@ import time
 
 import jax
 import numpy as np
+from jax import lax
 
 
 def _drain(metrics) -> None:
@@ -52,6 +53,75 @@ def _published(key: str):
         return None
 
 
+def _vs(value: float, anchor, what: str):
+    """Ratchet ratio, or None (plus a loud stderr note) when the anchor
+    is missing — a corrupted BASELINE.json must not silently turn the
+    ratchet back into a constant 1.0."""
+    if not anchor:
+        print(f"# WARNING: no published anchor for {what}; "
+              "vs_baseline unavailable", file=sys.stderr)
+        return None
+    return round(value / anchor, 3)
+
+
+def _env_stamp() -> dict:
+    """Where this artifact was actually measured. Round 3's official
+    capture ran ~18x below the in-session numbers and the artifact
+    could not say whether the backend, the tunnel, or contention was at
+    fault — every record now carries the platform identity."""
+    d = jax.devices()[0]
+    return {"platform": d.platform, "device_kind": d.device_kind,
+            "num_devices": len(jax.devices()),
+            "jax_version": jax.__version__}
+
+
+def _scan_chunks(step_fn, state, gbatch, chunk_len: int, n_chunks: int):
+    """Time ``n_chunks`` dispatches of an ON-DEVICE ``lax.scan`` of
+    ``chunk_len`` training steps each.
+
+    The round-3 driver capture showed per-step wall times ~18x the
+    in-session steady state; with one host dispatch per step, the
+    artifact could not separate device throughput from host/tunnel
+    pathology. Scanning the step on-device makes the timed region one
+    XLA program per chunk: whatever the relay latency is, it amortizes
+    over ``chunk_len`` steps, and the per-chunk spread (reported as a
+    histogram) shows contention instead of hiding it. ≙ the steady-
+    state throughput the reference reports from in-run step timing
+    (src/distributed_train.py:365-371).
+
+    Returns (chunk_seconds list, compile_seconds, final_state).
+    """
+    def chunk(st, batch):
+        def body(carry, _):
+            new_state, metrics = step_fn(carry, batch)
+            return new_state, metrics["loss"]
+        final, losses = lax.scan(body, st, None, length=chunk_len)
+        return final, losses[-1]
+
+    run = jax.jit(chunk, donate_argnums=0)
+    t0 = time.perf_counter()
+    state, loss = run(state, gbatch)
+    float(loss)  # drain (see _drain)
+    compile_s = time.perf_counter() - t0
+
+    # Dispatch every chunk before fetching any: the device queue runs
+    # the chunks back-to-back while the ~70 ms tunnel relay of each
+    # fetch overlaps the next chunk's compute, so exactly ONE relay
+    # latency lands in the timed window instead of one per chunk.
+    losses = []
+    t0 = time.perf_counter()
+    for _ in range(n_chunks):
+        state, loss = run(state, gbatch)
+        losses.append(loss)
+    times, prev = [], t0
+    for loss in losses:
+        float(loss)  # returns when that chunk has drained
+        now = time.perf_counter()
+        times.append(now - prev)
+        prev = now
+    return times, compile_s, state
+
+
 def _build(cfg_dict: dict, topo=None):
     from distributedmnist_tpu.core.config import ExperimentConfig
     from distributedmnist_tpu.core.mesh import make_topology
@@ -68,19 +138,10 @@ def _build(cfg_dict: dict, topo=None):
     return cfg, topo, model, state, step_fn
 
 
-def _time_steps(step_fn, state, gbatch, warmup: int, timed: int) -> tuple:
-    for _ in range(warmup):
-        state, metrics = step_fn(state, gbatch)
-    _drain(metrics)
-    t0 = time.perf_counter()
-    for _ in range(timed):
-        state, metrics = step_fn(state, gbatch)
-    _drain(metrics)
-    return time.perf_counter() - t0, state
-
-
 def bench_cnn_sync() -> dict:
-    """Headline: flagship CNN, plain sync mode."""
+    """Headline: flagship CNN, plain sync mode. The timed region is an
+    on-device scan (one dispatch per chunk of steps) so the number is
+    device throughput, not host/tunnel round-trip pacing."""
     from distributedmnist_tpu.data.datasets import make_synthetic
 
     n_dev = len(jax.devices())
@@ -93,21 +154,33 @@ def bench_cnn_sync() -> dict:
     ds = make_synthetic(num_train=batch, num_test=256)
     gbatch = topo.device_put_batch(
         {"image": ds.train.images[:batch], "label": ds.train.labels[:batch]})
-    timed = 100
-    dt, _ = _time_steps(step_fn, state, gbatch, warmup=10, timed=timed)
+    chunk_len, n_chunks = 50, 6
+    times, compile_s, _ = _scan_chunks(step_fn, state, gbatch,
+                                       chunk_len, n_chunks)
+    dt = sum(times)
+    timed = chunk_len * n_chunks
     images_per_sec = timed * batch / dt
     per_chip = images_per_sec / n_dev
+    step_ms = [round(t / chunk_len * 1e3, 3) for t in times]
 
-    baseline = _published("images_per_sec_per_chip")
-    vs = per_chip / baseline if baseline else 1.0
+    vs = _vs(per_chip, _published("images_per_sec_per_chip"),
+             "images_per_sec_per_chip")
     print(f"# devices={n_dev} global_batch={batch} steps={timed} "
-          f"wall={dt:.3f}s total={images_per_sec:.0f} img/s", file=sys.stderr)
-    return {
+          f"wall={dt:.3f}s total={images_per_sec:.0f} img/s "
+          f"compile={compile_s:.1f}s", file=sys.stderr)
+    record = {
         "metric": "mnist_cnn_sync_sgd_images_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "images/sec/chip",
-        "vs_baseline": round(vs, 3),
+        "vs_baseline": vs,
+        "detail": {**_env_stamp(), "compile_s": round(compile_s, 2),
+                   "chunk_len": chunk_len,
+                   "per_step_ms_by_chunk": step_ms},
     }
+    if vs is not None and vs < 0.5:
+        record["degraded"] = True  # loud: the chip ran far below the
+        # committed ratchet — see detail for platform/contention evidence
+    return record
 
 
 def bench_transformer_flash() -> None:
@@ -127,8 +200,11 @@ def bench_transformer_flash() -> None:
     rng = np.random.default_rng(0)
     toks = rng.integers(0, V, (B, S), dtype=np.int32)
     gbatch = topo.device_put_batch({"image": toks, "label": toks.copy()})
-    warmup, timed = 5, 20
-    dt, _ = _time_steps(step_fn, state, gbatch, warmup=warmup, timed=timed)
+    chunk_len, n_chunks = 5, 4
+    times, compile_s, _ = _scan_chunks(step_fn, state, gbatch,
+                                       chunk_len, n_chunks)
+    dt = sum(times)
+    timed = chunk_len * n_chunks
 
     # Matmul FLOPs per token, fwd: qkv 6d² + out-proj 2d² + MLP 16d²
     # per layer, plus causal attention 2·(2·S·d)·½ per layer, plus the
@@ -136,14 +212,22 @@ def bench_transformer_flash() -> None:
     fwd_per_token = L * (24 * d * d + 2 * S * d) + 2 * d * V
     flops = 3 * fwd_per_token * B * S * timed
     tflops = flops / dt / 1e12 / n_dev
-    anchor = _published("transformer_flash_tflops_per_chip")
-    _case({"metric": "transformer_flash_train_tflops_per_chip",
-           "value": round(tflops, 2), "unit": "TFLOP/s/chip",
-           "vs_baseline": round(tflops / anchor, 3) if anchor else 1.0,
-           "detail": {"dims": {"d": d, "L": L, "H": H, "S": S, "V": V,
-                               "B": B},
-                      "steps_per_sec": round(timed / dt, 3),
-                      "tokens_per_sec": round(timed * B * S / dt, 1)}})
+    vs = _vs(tflops, _published("transformer_flash_tflops_per_chip"),
+             "transformer_flash_tflops_per_chip")
+    record = {"metric": "transformer_flash_train_tflops_per_chip",
+              "value": round(tflops, 2), "unit": "TFLOP/s/chip",
+              "vs_baseline": vs,
+              "detail": {"dims": {"d": d, "L": L, "H": H, "S": S, "V": V,
+                                  "B": B},
+                         "steps_per_sec": round(timed / dt, 3),
+                         "tokens_per_sec": round(timed * B * S / dt, 1),
+                         "compile_s": round(compile_s, 2),
+                         "per_step_ms_by_chunk": [
+                             round(t / chunk_len * 1e3, 2) for t in times],
+                         **_env_stamp()}}
+    if vs is not None and vs < 0.5:
+        record["degraded"] = True
+    _case(record)
 
 
 def bench_mode_overhead() -> None:
@@ -166,9 +250,10 @@ def bench_mode_overhead() -> None:
             "sync": sync_cfg,
         })
         gbatch = topo.device_put_batch(host_batch)
-        timed = 60
-        dt, _ = _time_steps(step_fn, state, gbatch, warmup=8, timed=timed)
-        return timed * batch / dt
+        chunk_len, n_chunks = 20, 3
+        times, _, _ = _scan_chunks(step_fn, state, gbatch,
+                                   chunk_len, n_chunks)
+        return chunk_len * n_chunks * batch / sum(times)
 
     base = run({"mode": "sync"})
     n = len(jax.devices())
@@ -229,45 +314,81 @@ def bench_native_loader() -> None:
         decode["python_MBps"] = round(nbytes / (time.perf_counter() - t0)
                                       / 1e6, 1)
 
-    # (b) pipeline rate with an overlapping consumer. Construct both
-    # iterators DIRECTLY — make_train_iterator's 1-core gate would
-    # silently hand back the python pipeline for "native" and this case
-    # would benchmark python against itself.
+    # (b) pipeline rate under TWO consumer shapes. Construct both
+    # iterators DIRECTLY — make_train_iterator's gate would silently
+    # hand back the python pipeline for "native" and this case would
+    # benchmark python against itself.
+    #
+    #   * cpu_busy: ≈2 ms of numpy per batch — models CPU-mesh
+    #     training, where the consumer's compute owns the host core and
+    #     a prefetch thread just fights it for cycles (the measured net
+    #     slowdown behind make_train_iterator's CPU-backend gate).
+    #   * device_blocked: the TRAIN LOOP's real shape on a TPU host —
+    #     per batch a jitted dispatch (cheap), every log-cadence a
+    #     scalar fetch that parks the host thread GIL-FREE in the
+    #     PJRT/tunnel relay (~70 ms here). That parked window is where
+    #     a 1-core host genuinely has spare cycles for the prefetch
+    #     thread — the case that decides the production gate.
     import os
 
     from distributedmnist_tpu.data.pipeline import BatchIterator
 
-    n_batches, batch = 200, 1024
+    n_batches, batch, cadence = 120, 4096, 10
     work = np.zeros((256, 256), np.float32)
-    rates = {}
-    for label in ("python", "native"):
-        it = BatchIterator(ds.train, batch, seed=0)
-        if label == "native":
-            try:
-                from distributedmnist_tpu.data.native_loader import (
-                    NativePrefetcher)
-            except ImportError as e:  # no C++ toolchain: still report
-                rates[label] = None   # the python rate + decode numbers
-                rates["native_error"] = f"{type(e).__name__}: {e}"
-                continue
-            it = NativePrefetcher(it, depth=4)
-        next(it)  # spin-up cost out of the timed window
-        t0 = time.perf_counter()
-        for _ in range(n_batches):
-            next(it)
-            work @ work  # ≈2 ms consumer work the prefetch can hide
-        rates[label] = n_batches / (time.perf_counter() - t0)
-        if hasattr(it, "close"):
-            it.close()
-    native, python = rates.get("native"), rates["python"]
+    dev_w = jax.device_put(np.zeros((128, 128), np.float32))
+    dev_step = jax.jit(lambda a: (a @ a).sum())
+    float(dev_step(dev_w))  # compile outside the timed region
+
+    def consume_cpu_busy(i, pending):
+        del i, pending
+        work @ work
+
+    def consume_device_blocked(i, pending):
+        pending.append(dev_step(dev_w))   # async dispatch, host returns
+        if (i + 1) % cadence == 0:
+            float(pending[-1])            # GIL-free park in the relay
+            pending.clear()
+
+    rates: dict = {}
+    for shape, consume in (("cpu_busy", consume_cpu_busy),
+                           ("device_blocked", consume_device_blocked)):
+        for label in ("python", "native"):
+            it = BatchIterator(ds.train, batch, seed=0)
+            if label == "native":
+                try:
+                    from distributedmnist_tpu.data.native_loader import (
+                        NativePrefetcher)
+                except ImportError as e:  # no C++ toolchain: still report
+                    rates[f"{shape}_native"] = None
+                    rates["native_error"] = f"{type(e).__name__}: {e}"
+                    continue
+                it = NativePrefetcher(it, depth=cadence)
+            next(it)  # spin-up cost out of the timed window
+            pending: list = []
+            t0 = time.perf_counter()
+            for i in range(n_batches):
+                next(it)
+                consume(i, pending)
+            rates[f"{shape}_{label}"] = n_batches / (time.perf_counter() - t0)
+            if hasattr(it, "close"):
+                it.close()
+
+    def ratio(shape: str):
+        n, p = rates.get(f"{shape}_native"), rates.get(f"{shape}_python")
+        return round(n / p, 2) if n and p else rates.get("native_error")
+
+    native = rates.get("device_blocked_native")
     _case({"metric": "native_loader_overlapped_batches_per_sec",
            "value": round(native, 1) if native else None,
            "unit": "batches/sec",
-           "detail": {"python_batches_per_sec": round(python, 1),
-                      "pipeline_speedup_vs_python": (
-                          round(native / python, 2) if native else
-                          rates.get("native_error")),
+           "detail": {"pipeline_speedup_vs_python": ratio("device_blocked"),
+                      "cpu_busy_speedup_vs_python": ratio("cpu_busy"),
+                      "rates_batches_per_sec": {
+                          k: round(v, 1) for k, v in rates.items()
+                          if isinstance(v, float)},
+                      "batch": batch, "fetch_cadence": cadence,
                       "host_cpu_count": os.cpu_count(),
+                      "backend": jax.default_backend(),
                       "idx_decode": decode}})
 
 

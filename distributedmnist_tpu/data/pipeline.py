@@ -140,9 +140,14 @@ def make_train_iterator(data: ArrayDataset, cfg: DataConfig, seed: int,
         from ..core.log import get_logger
         if (os.cpu_count() or 1) < 2:
             # a prefetch thread can only fight the consumer for the one
-            # core (measured as a net slowdown by bench_native_loader);
-            # prefetching pays off when it overlaps with device compute
-            # on a spare core
+            # core — measured as a net slowdown by bench_native_loader
+            # under BOTH consumer shapes: cpu-busy (0.5x) AND the train
+            # loop's real device-blocked shape, where the host parks
+            # GIL-free in the ~70 ms tunnel fetch (0.89x: the parked
+            # window is long enough to pre-build a few batches, but the
+            # per-batch queue handoff on one core costs more than the
+            # ~2 ms prep it hides). Prefetching pays off when a SPARE
+            # core runs the producer.
             get_logger("data").info(
                 "single-core host: skipping the prefetch thread, "
                 "using inline batching")
