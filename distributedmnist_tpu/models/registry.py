@@ -221,13 +221,15 @@ def _transformer(cfg: ModelConfig) -> Model:
         expert-parallel shards."""
         sharded_attn = make_seq_attn(seq_axis)
 
-        if moe and seq_axis is not None:
-            raise ValueError("mixture-of-experts does not yet compose with "
-                             "sequence parallelism (capacity would become "
-                             "shard-local)")
         if expert_axis is not None and not moe:
             raise ValueError("mesh has expert parallelism but the model has "
                              "no experts (model.num_experts == 0)")
+
+        # SP×MoE: tokens are already seq-sharded; routing runs on each
+        # shard's slice with shard-local capacity (ops/moe.py module
+        # doc), while the aux statistics average over the seq axis so
+        # the load-balance loss stays the exact full-token value.
+        stats_axes = (seq_axis,) if (moe and seq_axis is not None) else ()
 
         def apply_sharded(params, tokens, positions, return_aux=False):
             return transformer.apply(params, tokens, num_heads=cfg.num_heads,
@@ -239,6 +241,7 @@ def _transformer(cfg: ModelConfig) -> Model:
                                      num_experts=cfg.num_experts,
                                      capacity_factor=cfg.expert_capacity_factor,
                                      remat=cfg.remat,
+                                     moe_stats_axes=stats_axes,
                                      return_aux=return_aux)
 
         return apply_sharded

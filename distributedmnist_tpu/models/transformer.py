@@ -121,6 +121,7 @@ def apply(params: Params, tokens: jax.Array, *, num_heads: int = 4,
           model_axis: str | None = None,
           expert_axis: str | None = None, num_experts: int = 0,
           capacity_factor: float = 1.25, remat: bool = False,
+          moe_stats_axes: tuple[str, ...] = (),
           return_aux: bool = False) -> jax.Array:
     """tokens [batch, seq] int32 → logits [batch, seq, vocab] float32.
 
@@ -139,6 +140,9 @@ def apply(params: Params, tokens: jax.Array, *, num_heads: int = 4,
     ``model_axis``: heads and every expert's hidden dim are
     tensor-parallel over the model axis, experts over the expert axis,
     with one fused psum per MoE block covering both.
+    ``moe_stats_axes``: extra token-sharding axes (the seq axis under
+    SP×MoE) the load-balance statistics average over, so the aux loss
+    is the full-token value replicated on every shard.
     ``return_aux``: also return the summed load-balancing aux loss.
     """
     attn = attention_fn or local_self_attention
@@ -160,7 +164,8 @@ def apply(params: Params, tokens: jax.Array, *, num_heads: int = 4,
                             model_axis=model_axis,
                             expert_axis=expert_axis,
                             num_experts=num_experts,
-                            capacity_factor=capacity_factor)
+                            capacity_factor=capacity_factor,
+                            moe_stats_axes=moe_stats_axes)
 
     if remat:
         # trade one extra forward per block for O(layer-boundary)
@@ -178,7 +183,8 @@ def apply(params: Params, tokens: jax.Array, *, num_heads: int = 4,
 def _apply_block(x: jax.Array, blk: Params, *, h_local: int, hd: int,
                  attn: Callable, model_axis: str | None,
                  expert_axis: str | None = None, num_experts: int = 0,
-                 capacity_factor: float = 1.25) -> tuple[jax.Array, jax.Array]:
+                 capacity_factor: float = 1.25,
+                 moe_stats_axes: tuple[str, ...] = ()) -> tuple[jax.Array, jax.Array]:
     """One pre-norm transformer block (shared by the dense/TP loop and
     the pipeline stage scan). Returns (x, moe_aux_loss) — aux is 0 for
     dense-FFN blocks."""
@@ -203,7 +209,8 @@ def _apply_block(x: jax.Array, blk: Params, *, h_local: int, hd: int,
                            num_experts=num_experts,
                            capacity_factor=capacity_factor,
                            expert_axis=expert_axis,
-                           tp_axis=model_axis)
+                           tp_axis=model_axis,
+                           stats_axes=moe_stats_axes)
     else:
         mlp = jax.nn.relu(h @ blk["w1"]) @ blk["w2"]
         aux = jnp.zeros((), jnp.float32)

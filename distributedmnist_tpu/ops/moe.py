@@ -8,13 +8,32 @@ einsum formulation (arXiv:2006.16668) — static shapes throughout, so
 XLA sees two dense batched matmuls per expert shard and the MXU stays
 busy regardless of routing.
 
-Expert-parallel layout mirrors the framework's tensor-parallel
-pattern: activations are REPLICATED over the expert axis, each rank
-holds ``E / axis_size`` experts' weights, computes dispatch/combine
-for its local experts only, and one psum over the axis reassembles the
-combined output. No all-to-all is needed in this layout because tokens
-are already visible to every expert rank; the psum payload is [t, d]
-activations, riding ICI.
+Expert-parallel layout (GShard all-to-all dispatch): the expert axis
+doubles as a token-group axis inside the MoE block. Each rank slices
+its 1/G of the (replicated) token set — free, no collective — routes
+those tokens locally with SHARD-LOCAL capacity ceil(cf·t_g/E), and two
+``lax.all_to_all``s carry only the dispatched capacity slices
+[E_local, G·C_g, d] to the expert owners and back. Routing and the
+dispatch/combine einsums therefore run over t/G tokens per rank
+(the round-3 layout ran them redundantly over all t on every rank).
+The combined group outputs are reassembled replicated via the
+framework's scatter+psum idiom (parallel/api.py:_gather_replicated —
+an ``all_gather`` result stays tracked device-varying and could not
+feed the replicated residual stream), fused over the expert and TP
+axes in one reduction.
+
+Capacity semantics: capacity is LOCAL to each token group — a group
+whose tokens concentrate on one expert drops tokens that would have
+fit under global capacity. This is the documented GShard trade (group-
+local dispatch keeps every shape static and the collectives capacity-
+sized); with ``capacity_factor ≥ E/…`` such that C_g ≥ t_g nothing can
+ever drop and the EP output equals the dense oracle exactly
+(tests/test_moe.py gold-parity tests).
+
+The load-balance statistics are averaged over the expert axis (and any
+``stats_axes``, e.g. the sequence axis under SP×EP) BEFORE forming the
+aux loss, so ``aux`` equals the dense computation over the full token
+set exactly — group-local aux would bias toward per-group imbalance.
 """
 
 from __future__ import annotations
@@ -26,14 +45,48 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _route(xg: jax.Array, router_w: jax.Array, e: int, cap: int):
+    """Top-1 routing over one token group [t, d] → dispatch/combine
+    [t, e, cap] (f32) plus per-expert load statistics [e]."""
+    logits = (xg @ router_w.astype(xg.dtype)).astype(jnp.float32)  # [t, e]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate = jnp.max(probs, axis=-1)                    # [t]
+    choice = jnp.argmax(probs, axis=-1)               # [t]
+    onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # [t, e]
+    # position of each token within its expert's queue (0-based);
+    # tokens past capacity get a zero dispatch row (dropped -> residual)
+    pos = jnp.sum((jnp.cumsum(onehot, axis=0) - 1.0) * onehot,
+                  axis=-1).astype(jnp.int32)          # [t]
+    slot = jax.nn.one_hot(pos, cap, dtype=jnp.float32)  # [t, cap]
+    dispatch = onehot[:, :, None] * slot[:, None, :]    # [t, e, cap]
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine, jnp.mean(onehot, axis=0), jnp.mean(probs, axis=0)
+
+
+def _expert_ffn(expert_in: jax.Array, w1: jax.Array, w2: jax.Array,
+                dtype) -> jax.Array:
+    """[e_local, c, d] through each local expert's two-layer FFN —
+    scanned so XLA emits one fused kernel pair per expert shard."""
+    def one_expert(carry, packed):
+        del carry
+        inp, w1_e, w2_e = packed
+        h = jax.nn.relu(inp @ w1_e.astype(dtype))
+        return None, h @ w2_e.astype(dtype)
+
+    _, expert_out = lax.scan(one_expert, None, (expert_in, w1, w2))
+    return expert_out
+
+
 def moe_ffn(x: jax.Array, router_w: jax.Array, w1: jax.Array, w2: jax.Array,
             *, num_experts: int, capacity_factor: float = 1.25,
             expert_axis: str | None = None,
-            tp_axis: str | None = None) -> tuple[jax.Array, jax.Array]:
+            tp_axis: str | None = None,
+            stats_axes: tuple[str, ...] = ()) -> tuple[jax.Array, jax.Array]:
     """Top-1 routed expert FFN.
 
     Args (inside shard_map when ``expert_axis``/``tp_axis`` are set):
-      x: [batch, seq, d] activations (replicated over both axes).
+      x: [batch, seq, d] activations (replicated over both axes; under
+        SP the caller passes its seq-local slice).
       router_w: [d, E] routing weights (replicated).
       w1: [E_local, d, ff_local], w2: [E_local, ff_local, d] — THIS
         rank's expert slice (E_local = E / expert-axis size) and, with
@@ -43,9 +96,13 @@ def moe_ffn(x: jax.Array, router_w: jax.Array, w1: jax.Array, w2: jax.Array,
         expert's FFN across the model axis, and ONE fused psum over
         both axes reassembles the combined output.
       num_experts: E (global).
-      capacity_factor: per-expert capacity = ceil(cf · tokens / E);
+      capacity_factor: per-group capacity = ceil(cf · t_group / E);
         overflow tokens pass through the residual unchanged (their
-        combine weight is zero).
+        combine weight is zero). Under EP the group is this rank's t/G
+        token slice — capacity is shard-local (module docstring).
+      stats_axes: extra mesh axes whose token shards the load-balance
+        statistics must average over (the seq axis under SP), so the
+        aux loss matches the dense full-token computation exactly.
 
     Returns (out [batch, seq, d], aux): ``aux`` is the Switch
     load-balancing loss E·Σ_e(fraction_e · mean_prob_e), ≈1 when
@@ -54,52 +111,57 @@ def moe_ffn(x: jax.Array, router_w: jax.Array, w1: jax.Array, w2: jax.Array,
     b, s, d = x.shape
     t = b * s
     e = num_experts
-    cap = max(1, math.ceil(capacity_factor * t / e))
     xf = x.reshape(t, d)
-
-    logits = (xf @ router_w.astype(xf.dtype)).astype(jnp.float32)  # [t, E]
-    probs = jax.nn.softmax(logits, axis=-1)
-    gate = jnp.max(probs, axis=-1)                    # [t]
-    choice = jnp.argmax(probs, axis=-1)               # [t]
-    onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # [t, E]
-
-    # load-balance aux: fraction of tokens vs mean router prob per expert
-    aux = e * jnp.sum(jnp.mean(onehot, axis=0) * jnp.mean(probs, axis=0))
-
-    # position of each token within its expert's queue (0-based);
-    # tokens past capacity get a zero dispatch row (dropped -> residual)
-    pos = jnp.sum((jnp.cumsum(onehot, axis=0) - 1.0) * onehot,
-                  axis=-1).astype(jnp.int32)          # [t]
-    slot = jax.nn.one_hot(pos, cap, dtype=jnp.float32)  # [t, C]
-    dispatch = onehot[:, :, None] * slot[:, None, :]    # [t, E, C]
-
-    if expert_axis is not None:
-        e_local = w1.shape[0]
-        me = lax.axis_index(expert_axis)
-        dispatch = lax.dynamic_slice_in_dim(dispatch, me * e_local, e_local,
-                                            axis=1)   # [t, E_local, C]
-    combine = dispatch * gate[:, None, None]
-
-    # routing math stayed f32 above; the FFN FLOPs run in the compute
-    # dtype like the dense branch (bf16 feeds the MXU at full rate)
+    # routing math stays f32 (inside _route); the FFN FLOPs run in the
+    # compute dtype like the dense branch (bf16 feeds the MXU full-rate)
     dtype = x.dtype
-    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(dtype), xf)
 
-    def one_expert(carry, packed):
-        del carry
-        inp, w1_e, w2_e = packed
-        h = jax.nn.relu(inp @ w1_e.astype(dtype))
-        return None, h @ w2_e.astype(dtype)
+    if expert_axis is None:
+        cap = max(1, math.ceil(capacity_factor * t / e))
+        dispatch, combine, frac, mprob = _route(xf, router_w, e, cap)
+        expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(dtype), xf)
+        expert_out = _expert_ffn(expert_in, w1, w2, dtype)   # [e, cap, d]
+        out = jnp.einsum("tec,ecd->td", combine.astype(dtype), expert_out)
+        if tp_axis is not None:
+            out = lax.psum(out, tp_axis)
+    else:
+        e_local = w1.shape[0]
+        g = e // e_local                  # expert-axis size (static)
+        if t % g:
+            raise ValueError(
+                f"MoE token count {t} (batch {b} × seq {s}) must divide "
+                f"by the expert-parallel group count {g}")
+        t_g = t // g
+        me = lax.axis_index(expert_axis)
+        # this rank's token group — a local slice of the replicated set
+        xg = lax.dynamic_slice_in_dim(xf, me * t_g, t_g, axis=0)
+        cap = max(1, math.ceil(capacity_factor * t_g / e))   # shard-local
+        dispatch, combine, frac, mprob = _route(xg, router_w, e, cap)
+        expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(dtype), xg)
+        # all-to-all #1: [E, C_g, d] → [E_local, G·C_g, d] — each rank
+        # receives, for its local experts, every group's capacity slice
+        expert_in = lax.all_to_all(expert_in, expert_axis, 0, 1, tiled=True)
+        expert_out = _expert_ffn(expert_in, w1, w2, dtype)
+        # all-to-all #2 (inverse): [E_local, G·C_g, d] → [E, C_g, d] —
+        # this group's slots come home from every expert owner, experts
+        # back in global order (owners are rank-ordered)
+        expert_out = lax.all_to_all(expert_out, expert_axis, 1, 0, tiled=True)
+        out_g = jnp.einsum("tec,ecd->td", combine.astype(dtype), expert_out)
+        # reassemble the replicated [t, d] residual input: scatter+psum
+        # (the _gather_replicated idiom — statically replicated, unlike
+        # all_gather), fused with the TP row-parallel reduction
+        scat = lax.dynamic_update_slice_in_dim(
+            jnp.zeros((t, d), dtype), out_g, me * t_g, axis=0)
+        reduce_axes = ((expert_axis, tp_axis) if tp_axis is not None
+                       else (expert_axis,))
+        out = lax.psum(scat, reduce_axes)
 
-    _, expert_out = lax.scan(one_expert, None,
-                             (expert_in, w1, w2))     # [E_local, C, d]
-    out = jnp.einsum("tec,ecd->td", combine.astype(dtype), expert_out)
-    # One psum reassembles both decompositions: over the expert axis
-    # (each rank combined only its local experts) and the TP axis (each
-    # rank's w2 row-slice yields a partial sum of the full d).
-    reduce_axes = tuple(a for a in (expert_axis, tp_axis) if a is not None)
-    if reduce_axes:
-        out = lax.psum(out, reduce_axes)
-        # (aux needs no reduction: the router is replicated, so every
-        # rank computed the identical value)
+    stat_axes = ((() if expert_axis is None else (expert_axis,))
+                 + tuple(stats_axes))
+    if stat_axes:
+        # equal-sized groups ⇒ the mean of group means IS the global
+        # mean: aux computed from these equals the dense aux exactly
+        frac = lax.pmean(frac, stat_axes)
+        mprob = lax.pmean(mprob, stat_axes)
+    aux = e * jnp.sum(frac * mprob)
     return out.reshape(b, s, d), aux.astype(jnp.float32)

@@ -285,7 +285,7 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
             b, s_loc = tokens.shape
             me_s = lax.axis_index(seq_ax)
             positions = me_s * s_loc + jnp.arange(s_loc)
-            if with_aux:  # EP path (seq axis is size 1 — guarded in registry)
+            if with_aux:  # MoE: EP-only (seq axis size 1) or SP×EP
                 logits, aux = apply_fn(params, tokens, positions,
                                        return_aux=True)
             else:
@@ -303,7 +303,11 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
             nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
             correct = (jnp.argmax(logp, axis=-1) == tgt).astype(jnp.float32)
             total = b * (s_global - 1)  # this replica's global token count
-            return (jnp.sum(nll * w) / total + aux_w * aux,
+            # aux is already the full-token value on every seq shard
+            # (moe_ffn pmeans its stats over the stats_axes), so the
+            # caller's psum over the seq axis would count it n_seq
+            # times — pre-divide so the psum reassembles exactly one.
+            return (jnp.sum(nll * w) / total + aux_w * aux / n_seq,
                     jnp.sum(correct * w) / total)
         return sp_loss
 

@@ -60,18 +60,48 @@ def test_capacity_overflow_drops_tokens():
     assert np.allclose(norms[2:], 0.0, atol=1e-6)
 
 
-def test_ep_matches_unsharded():
-    router, w1, w2 = _moe_weights(jax.random.PRNGKey(4))
-    x = jax.random.normal(jax.random.PRNGKey(5), (2, 8, D))
-    want, want_aux = moe_ffn(x, router, w1, w2, num_experts=E,
-                             capacity_factor=2.0)
+def test_ep_capacity_is_shard_local():
+    """Under EP the capacity budget is per token GROUP (ops/moe.py):
+    with every token routed to expert 0 and cf=1.0, each of the 4
+    groups keeps ceil(t_g/E)=1 token — its first — where the dense
+    oracle keeps the first ceil(t/E)=4 tokens overall. The documented
+    GShard shard-local-capacity trade, asserted."""
+    _, w1, w2 = _moe_weights(jax.random.PRNGKey(2))
+    router = jnp.zeros((D, E)).at[:, 0].set(1.0)
+    x = jnp.abs(jax.random.normal(jax.random.PRNGKey(3), (1, 16, D))) + 0.1
 
     topo = make_topology(MeshConfig(num_replicas=1, expert_parallelism=4))
     axis = topo.expert_axis
 
     def fn(x, router, w1, w2):
         return moe_ffn(x, router, w1, w2, num_experts=E,
-                       capacity_factor=2.0, expert_axis=axis)
+                       capacity_factor=1.0, expert_axis=axis)
+
+    out, _ = jax.jit(jax.shard_map(
+        fn, mesh=topo.mesh,
+        in_specs=(P(), P(), P(axis), P(axis)),
+        out_specs=(P(), P())))(x, router, w1, w2)
+    norms = np.linalg.norm(np.asarray(out)[0], axis=-1)
+    kept = norms > 1e-6
+    # groups are contiguous 4-token slices; each keeps exactly its first
+    assert kept.tolist() == [True, False, False, False] * 4
+
+
+def test_ep_matches_unsharded():
+    # capacity_factor=4 → shard-local capacity C_g = t_g, so the
+    # grouped all-to-all dispatch can never drop and must equal the
+    # dense oracle EXACTLY (ops/moe.py capacity semantics)
+    router, w1, w2 = _moe_weights(jax.random.PRNGKey(4))
+    x = jax.random.normal(jax.random.PRNGKey(5), (2, 8, D))
+    want, want_aux = moe_ffn(x, router, w1, w2, num_experts=E,
+                             capacity_factor=4.0)
+
+    topo = make_topology(MeshConfig(num_replicas=1, expert_parallelism=4))
+    axis = topo.expert_axis
+
+    def fn(x, router, w1, w2):
+        return moe_ffn(x, router, w1, w2, num_experts=E,
+                       capacity_factor=4.0, expert_axis=axis)
 
     got, got_aux = jax.jit(jax.shard_map(
         fn, mesh=topo.mesh,
@@ -89,7 +119,7 @@ def test_ep_tp_matches_unsharded():
     router, w1, w2 = _moe_weights(jax.random.PRNGKey(8))
     x = jax.random.normal(jax.random.PRNGKey(9), (2, 8, D))
     want, want_aux = moe_ffn(x, router, w1, w2, num_experts=E,
-                             capacity_factor=2.0)
+                             capacity_factor=4.0)
 
     topo = make_topology(MeshConfig(num_replicas=1, model_parallelism=2,
                                     expert_parallelism=2))
@@ -97,7 +127,7 @@ def test_ep_tp_matches_unsharded():
 
     def fn(x, router, w1, w2):
         return moe_ffn(x, router, w1, w2, num_experts=E,
-                       capacity_factor=2.0, expert_axis=e_ax, tp_axis=m_ax)
+                       capacity_factor=4.0, expert_axis=e_ax, tp_axis=m_ax)
 
     got, got_aux = jax.jit(jax.shard_map(
         fn, mesh=topo.mesh,
@@ -128,7 +158,9 @@ def _cfg(n_replicas=1):
         model={"name": "transformer", "compute_dtype": "float32",
                "seq_len": 16, "model_dim": 16, "num_heads": 2,
                "num_layers": 2, "vocab_size": 31, "attention_impl": "dense",
-               "num_experts": 4, "expert_capacity_factor": 2.0},
+               # cf=4 → per-group capacity == group size: no EP-vs-
+               # dense drop divergence in the gold-parity checks
+               "num_experts": 4, "expert_capacity_factor": 4.0},
         sync={"mode": "sync", "straggler_profile": "none"},
     )
 
@@ -157,20 +189,23 @@ def _dense_moe_update(cfg, batch):
     return loss, jax.tree.map(lambda p, g: p - LR * g, params, grads)
 
 
-@pytest.mark.parametrize("n_replicas,n_expert,n_model", [
-    (1, 4, 1),   # pure EP
-    (2, 2, 1),   # DP×EP
-    (1, 2, 2),   # EP×TP: experts AND their hidden dims sharded
-    (2, 1, 2),   # DP×TP on a MoE model (all experts on every rank)
+@pytest.mark.parametrize("n_replicas,n_expert,n_model,n_seq", [
+    (1, 4, 1, 1),   # pure EP
+    (2, 2, 1, 1),   # DP×EP
+    (1, 2, 2, 1),   # EP×TP: experts AND their hidden dims sharded
+    (2, 1, 2, 1),   # DP×TP on a MoE model (all experts on every rank)
+    (1, 2, 1, 2),   # SP×EP: seq-sharded tokens through grouped dispatch
+    (1, 2, 2, 2),   # SP×EP×TP: all three model-side axes at once
 ])
-def test_ep_step_matches_dense_update(n_replicas, n_expert, n_model):
+def test_ep_step_matches_dense_update(n_replicas, n_expert, n_model, n_seq):
     cfg = _cfg(n_replicas=n_replicas)
     batch = _tokens(cfg)
     want_loss, want_params = _dense_moe_update(cfg, batch)
 
     topo = make_topology(MeshConfig(num_replicas=n_replicas,
                                     model_parallelism=n_model,
-                                    expert_parallelism=n_expert))
+                                    expert_parallelism=n_expert,
+                                    seq_parallelism=n_seq))
     model = get_model(cfg.model)
     specs = state_partition_specs(model, cfg, topo)
     state = topo.device_put_state(init_train_state(model, cfg, topo), specs)
@@ -185,11 +220,13 @@ def test_ep_step_matches_dense_update(n_replicas, n_expert, n_model):
                                    rtol=3e-4, atol=3e-5)
 
 
-def test_moe_sp_combo_rejected():
+def test_moe_pp_combo_rejected():
+    """PP×EP stays refused: the aux loss cannot cross the stage
+    pipeline (parallel/api.py guard)."""
     cfg = _cfg()
     topo = make_topology(MeshConfig(num_replicas=1, expert_parallelism=2,
-                                    seq_parallelism=2))
-    with pytest.raises(ValueError, match="sequence parallelism"):
+                                    pipeline_parallelism=2))
+    with pytest.raises(ValueError, match="pipeline"):
         build_train_step(get_model(cfg.model), cfg, topo, constant(LR))
 
 
