@@ -269,6 +269,13 @@ def make_topology(cfg: MeshConfig | None = None,
         # A config that trained on a simulated mesh must be loadable by
         # every consumer (evaluator, sweep, report), not just the train
         # CLI — tear down the 1-device backend and force the CPU mesh.
+        # Capture the TRUE ambient devices first: if ensure_mesh's
+        # lazy capture ran only after this forcing, it would record the
+        # simulated mesh as "ambient" and a later simulate_devices=0
+        # config would silently keep running on the forced mesh.
+        global _ambient_mesh
+        if _ambient_mesh is None:
+            _ambient_mesh = (len(jax.devices()), jax.default_backend())
         import jax.extend.backend as jeb
         jeb.clear_backends()
         simulate_devices(cfg.simulate_devices)

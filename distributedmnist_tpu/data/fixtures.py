@@ -20,6 +20,8 @@ from pathlib import Path
 
 import numpy as np
 
+import pickle
+
 from .datasets import (_IDX_FILES, _open_maybe_gz, make_synthetic,
                        write_idx_ubyte)
 
@@ -80,4 +82,61 @@ def materialize_idx_fixture(data_dir: str | Path, dataset: str = "mnist",
         f"has no network egress. seed={seed}, "
         f"{num_train} train / {num_test} test. NOT the real archives — "
         "same format, shapes, dtype and split sizes.\n")
+    return root
+
+
+def materialize_cifar10_fixture(data_dir: str | Path,
+                                num_train: int = 50000,
+                                num_test: int = 10000) -> Path:
+    """Write a full CIFAR-10 python-pickle batch set under ``data_dir``
+    (idempotent) so ``load_cifar10``'s REAL parse path — pickle decode,
+    [N, 3072] u8 → NHWC transpose, pixel normalization — runs end to
+    end (≙ the ingest fidelity of src/mnist_data.py:132-155 applied to
+    BASELINE config #5, which otherwise only ever hits the logged
+    synthetic fallback).
+
+    Layout matches the real archive: ``cifar-10-batches-py/`` holding
+    five ``data_batch_N`` of 10k rows each plus ``test_batch``, every
+    pickle a dict with b"data" [N, 3072] uint8 (CHW channel-major rows)
+    and b"labels".
+    """
+    root = Path(data_dir)
+    batch_dir = root / "cifar-10-batches-py"
+    n_batches = 5
+    per = num_train // n_batches
+    files = [batch_dir / f"data_batch_{i + 1}" for i in range(n_batches)]
+    files.append(batch_dir / "test_batch")
+    if all(p.exists() for p in files):
+        return root
+    batch_dir.mkdir(parents=True, exist_ok=True)
+    seed = _FIXTURE_SEEDS.get("cifar10", 67890)
+    ds = make_synthetic(num_train, num_test, image_size=32, num_channels=3,
+                        seed=seed)
+
+    def to_rows(images: np.ndarray) -> np.ndarray:
+        # inverse of load_cifar10's (u8 - 127.5)/255, NHWC → [N, 3072]
+        # channel-major rows exactly as the archive stores them
+        u8 = np.clip(np.round(images * 255.0 + 127.5), 0, 255).astype(np.uint8)
+        return np.ascontiguousarray(
+            u8.transpose(0, 3, 1, 2).reshape(len(u8), -1))
+
+    # convert per 10k batch, not the whole train set at once — caps the
+    # u8/transpose copies at one batch's worth on top of the float base
+    for i, path in enumerate(files[:n_batches]):
+        sl = slice(i * per, (i + 1) * per)
+        with open(path, "wb") as f:
+            pickle.dump({b"data": to_rows(ds.train.images[sl]),
+                         b"labels": ds.train.labels[sl].tolist(),
+                         b"batch_label": f"fixture batch {i + 1}".encode()}, f)
+    with open(files[-1], "wb") as f:
+        pickle.dump({b"data": to_rows(ds.test.images),
+                     b"labels": ds.test.labels.tolist(),
+                     b"batch_label": b"fixture test batch"}, f)
+    (root / "PROVENANCE.md").write_text(
+        "# Fixture dataset (cifar10)\n\n"
+        "Deterministic synthetic data materialized in the CIFAR-10 "
+        "python pickle batch format (distributedmnist_tpu.data."
+        f"fixtures) because this environment has no network egress. "
+        f"seed={seed}, {num_train} train / {num_test} test. NOT the "
+        "real archive — same layout, shapes, dtype and split sizes.\n")
     return root

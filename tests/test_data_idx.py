@@ -222,3 +222,29 @@ def test_materialize_idx_fixture_roundtrip(tmp_path):
     before = (root / "train-images-idx3-ubyte.gz").stat().st_mtime
     materialize_idx_fixture(tmp_path, "mnist", num_train=256, num_test=64)
     assert (root / "train-images-idx3-ubyte.gz").stat().st_mtime == before
+
+
+def test_materialize_cifar10_fixture_roundtrip(tmp_path):
+    """The CIFAR-10 fixture exercises load_cifar10's REAL parse path:
+    five pickle batches + test_batch, [N, 3072] channel-major u8 rows
+    decoded to NHWC in [-0.5, 0.5], matching the generating synthetic
+    data to u8 quantization; generation is idempotent."""
+    from distributedmnist_tpu.data.fixtures import (_FIXTURE_SEEDS,
+                                                    materialize_cifar10_fixture)
+    root = materialize_cifar10_fixture(tmp_path, num_train=500, num_test=100)
+    batch_dir = root / "cifar-10-batches-py"
+    assert sorted(p.name for p in batch_dir.iterdir()) == (
+        [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"])
+    d = ds.load_cifar10(root)
+    v = 500 // 10  # loader carves min(5000, n//10) validation rows
+    assert d.train.images.shape == (500 - v, 32, 32, 3)
+    assert d.test.images.shape == (100, 32, 32, 3)
+    assert -0.5 <= d.train.images.min() and d.train.images.max() <= 0.5
+    ref = ds.make_synthetic(500, 100, image_size=32, num_channels=3,
+                            seed=_FIXTURE_SEEDS.get("cifar10", 67890))
+    np.testing.assert_allclose(d.train.images, ref.train.images[v:],
+                               atol=0.51 / 255)
+    assert (d.train.labels == ref.train.labels[v:]).all()
+    before = (batch_dir / "data_batch_1").stat().st_mtime
+    materialize_cifar10_fixture(tmp_path, num_train=500, num_test=100)
+    assert (batch_dir / "data_batch_1").stat().st_mtime == before
