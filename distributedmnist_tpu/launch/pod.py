@@ -206,6 +206,70 @@ class PodManager:
         self.create()
         self.run_train()
 
+    # -- progress -------------------------------------------------------
+
+    def poll(self) -> dict[str, Any] | None:
+        """One progress probe: tail the remote ``train_log.jsonl``
+        (worker 0 — every host logs the same replicated metrics) and
+        parse the newest record. ≙ the reference's master-log poll that
+        greps ``Step N`` out of the remote stdout
+        (tools/benchmark.py:24-34), against the structured log instead
+        of a regex over freeform text.
+
+        Returns {"step", "record"} — step is -1 when the log does not
+        exist yet (run still booting). Dry-run returns None (argv
+        recorded).
+        """
+        log = shlex.quote(f"{self.cfg.remote_outdir}/train_log.jsonl")
+        out = self.runner.run(
+            self._ssh(f"tail -n 1 {log} 2>/dev/null || true", worker="0"),
+            capture=True, check=False)
+        if out is None:
+            return None
+        line = (out.stdout or "").strip().splitlines()
+        if not line:
+            return {"step": -1, "record": None}
+        try:
+            record = json.loads(line[-1])
+        except json.JSONDecodeError:
+            return {"step": -1, "record": None}  # torn write — next poll
+        return {"step": int(record.get("step", -1)), "record": record}
+
+    def wait_until_step(self, target: int, poll_secs: float = 30.0,
+                        timeout_secs: float = 24 * 3600.0) -> dict[str, Any]:
+        """Block until the remote run reaches ``target`` steps
+        (≙ benchmark.py's run-until-step-N loop :24-34). Dry-run
+        records exactly one poll argv and returns immediately."""
+        import time as _time
+        deadline = _time.monotonic() + timeout_secs
+        while True:
+            got = self.poll()
+            if got is None:  # dry-run
+                return {"step": target, "record": None, "dry_run": True}
+            if got["step"] >= target:
+                return got
+            if _time.monotonic() >= deadline:
+                raise PodError(
+                    f"remote run did not reach step {target} within "
+                    f"{timeout_secs:.0f}s (last seen: {got['step']})")
+            logger.info("step %d/%d — next poll in %.0fs",
+                        got["step"], target, poll_secs)
+            _time.sleep(poll_secs)
+
+    def run_until_step(self, target: int, poll_secs: float = 30.0,
+                       timeout_secs: float = 24 * 3600.0) -> dict[str, Any]:
+        """Launch training, follow the remote log to step ``target``,
+        then stop the run — the reference's benchmark driver shape
+        (launch → poll ssh'd log → kill at N, tools/benchmark.py:24-44).
+        """
+        self.run_train()
+        try:
+            return self.wait_until_step(target, poll_secs, timeout_secs)
+        finally:
+            # stop the remote run on EVERY exit — a poll timeout or a
+            # Ctrl-C must not leave the pod training (and billing)
+            self.kill_all()
+
 
 def main(argv: list[str] | None = None) -> None:
     import argparse
@@ -213,7 +277,7 @@ def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(prog="distributedmnist_tpu.launch pod")
     p.add_argument("action",
                    choices=["create", "delete", "status", "run", "kill-all",
-                            "exec", "download", "clean-launch-run"])
+                            "exec", "download", "clean-launch-run", "poll"])
     p.add_argument("--config", default=None, help="PodConfig JSON")
     p.add_argument("--dry-run", action="store_true",
                    help="print gcloud commands instead of executing")
@@ -221,6 +285,12 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--worker", default=None, help="worker index or 'all'")
     p.add_argument("--local-dir", default="./pod_results", help="for download")
     p.add_argument("--remote-path", default=None, help="for download")
+    p.add_argument("--until-step", type=int, default=None, metavar="N",
+                   help="for run/poll: follow the remote train_log.jsonl "
+                        "and return at step N (run also stops the remote "
+                        "run, ≙ tools/benchmark.py:24-44)")
+    p.add_argument("--poll-secs", type=float, default=30.0,
+                   help="poll cadence for --until-step")
     args = p.parse_args(argv)
 
     cfg = PodConfig.from_file(args.config) if args.config else PodConfig()
@@ -232,7 +302,17 @@ def main(argv: list[str] | None = None) -> None:
     elif args.action == "status":
         print(json.dumps(mgr.status(), indent=2))
     elif args.action == "run":
-        mgr.run_train()
+        if args.until_step is not None:
+            print(json.dumps(mgr.run_until_step(args.until_step,
+                                                poll_secs=args.poll_secs)))
+        else:
+            mgr.run_train()
+    elif args.action == "poll":
+        if args.until_step is not None:
+            print(json.dumps(mgr.wait_until_step(args.until_step,
+                                                 poll_secs=args.poll_secs)))
+        else:
+            print(json.dumps(mgr.poll()))
     elif args.action == "kill-all":
         mgr.kill_all(worker=args.worker or "all")
     elif args.action == "exec":

@@ -85,6 +85,63 @@ def test_missing_binary_is_a_clear_error():
         Runner(dry_run=False).run(["dmt-no-such-binary-for-test"])
 
 
+def test_poll_argv_tails_structured_log():
+    m = _mgr(remote_outdir="/tmp/out")
+    assert m.poll() is None  # dry-run: argv recorded, no result
+    argv = m.runner.recorded[0]
+    i = argv.index("--worker")
+    assert argv[i + 1] == "0"
+    assert "tail -n 1 /tmp/out/train_log.jsonl" in argv[-1]
+
+
+def test_run_until_step_dry_run_sequence():
+    m = _mgr()
+    got = m.run_until_step(500)
+    assert got == {"step": 500, "record": None, "dry_run": True}
+    cmds = [a[-1] for a in m.runner.recorded]
+    assert "nohup" in cmds[0]          # launch
+    assert "tail -n 1" in cmds[1]      # exactly one poll (no spin)
+    assert "pkill" in cmds[2]          # stop at step N
+    assert len(cmds) == 3
+
+
+class _ScriptedRunner(Runner):
+    """Live-mode runner whose ssh polls return a scripted progression
+    of train_log tails — the until-step loop's test seam."""
+
+    def __init__(self, tails):
+        super().__init__(dry_run=False)
+        self.tails = list(tails)
+
+    def run(self, argv, check=True, capture=False):
+        self.recorded.append(list(argv))
+        cmd = argv[-1]
+        if "tail -n 1" in cmd:
+            out = self.tails.pop(0) if self.tails else ""
+            return type("R", (), {"stdout": out, "returncode": 0})()
+        return type("R", (), {"stdout": "", "returncode": 0})()
+
+
+def test_wait_until_step_follows_log_and_returns_at_target():
+    tails = ["",                                        # log not there yet
+             json.dumps({"step": 40, "loss": 1.0}),
+             "{\"step\": 80, \"loss\"",                 # torn write → retry
+             json.dumps({"step": 120, "loss": 0.2})]
+    m = PodManager(PodConfig(name="t", zone="z", remote_outdir="/tmp/out"),
+                   _ScriptedRunner(tails))
+    got = m.wait_until_step(100, poll_secs=0.0)
+    assert got["step"] == 120 and got["record"]["loss"] == 0.2
+    polls = [a for a in m.runner.recorded if "tail -n 1" in a[-1]]
+    assert len(polls) == 4
+
+
+def test_wait_until_step_times_out_with_last_seen():
+    m = PodManager(PodConfig(name="t", zone="z"),
+                   _ScriptedRunner([json.dumps({"step": 7})] * 50))
+    with pytest.raises(PodError, match=r"step 100.*last seen: 7"):
+        m.wait_until_step(100, poll_secs=0.0, timeout_secs=0.0)
+
+
 def test_cli_dry_run_prints_commands(capsys):
     from distributedmnist_tpu.launch.pod import main
     main(["create", "--dry-run"])
