@@ -193,6 +193,11 @@ class TrainConfig:
     async_checkpoint: bool = True
     resume: bool = True  # ≙ Supervisor restore-if-present (:262)
     profile_steps: tuple[int, int] = (0, 0)  # (start, stop) jax.profiler window
+    # Recurring trace dumps: every N steps, capture a one-window trace
+    # into train_dir/profile/step_<k> — the always-on trace debugging
+    # mode ≙ --timeline_logging's per-iteration Chrome traces
+    # (src/distributed_train.py:354-358). 0 disables.
+    trace_every_steps: int = 0
 
 
 @dataclass(frozen=True)

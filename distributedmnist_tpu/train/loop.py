@@ -194,7 +194,14 @@ class Trainer:
         # driven by the real measured host step time: each process feeds
         # its own measurement into its replicas' rows of the [n] vector
         # (this is what paces interval windows / timeout deadlines and
-        # ranks quorum contributors on real hardware).
+        # ranks quorum contributors on real hardware). Granularity is
+        # per PROCESS by construction: replicas of one process execute
+        # inside a single lockstep SPMD program, so a within-process
+        # per-replica clock cannot differ — real divergence enters at
+        # process boundaries (slow host, ingest, contention), which is
+        # exactly what this measures (proven live by
+        # tests/test_multihost.py::test_slow_process_loses_quorum_by_
+        # measured_time; ≙ per-worker times, src/timeout_manager.py:48-61).
         inject_measured = (self.cfg.sync.straggler_profile == "none"
                            and self.cfg.sync.mode in ("interval", "timeout",
                                                       "quorum", "cdf"))
@@ -263,6 +270,20 @@ class Trainer:
             pending.clear()
             last_log_t, last_log_step = now, upto
 
+        # Recurring per-window trace dumps (cfg.trace_every_steps): a
+        # one-step trace each cadence window, each under its own
+        # step_<k> directory — ≙ the reference's --timeline_logging
+        # per-iteration Chrome traces (src/distributed_train.py:354-358)
+        # at a bounded cadence instead of every step. Mutually
+        # exclusive with the one-shot profile_steps window (two
+        # concurrent jax.profiler traces cannot nest).
+        trace_every = max(0, cfg.trace_every_steps)
+        if trace_every and profile_stop > profile_start:
+            raise ValueError("set either train.profile_steps or "
+                             "train.trace_every_steps, not both "
+                             "(profiler traces cannot nest)")
+        tracing_step = None
+
         self.train_dir.mkdir(parents=True, exist_ok=True)
         step = self._start_step
         while step < total:
@@ -270,6 +291,11 @@ class Trainer:
             if in_window and not profiling and self.is_writer:
                 jax.profiler.start_trace(str(self.train_dir / "profile"))
                 profiling = True
+            if (trace_every and self.is_writer and tracing_step is None
+                    and step % trace_every == 0):
+                jax.profiler.start_trace(
+                    str(self.train_dir / "profile" / f"step_{step}"))
+                tracing_step = step
             t0 = time.time()
             batch = next(self.train_iter)
             gbatch = self.topo.device_put_batch(batch,
@@ -280,6 +306,13 @@ class Trainer:
             step += 1
             self.collector.add(metrics["step_times_ms"], host_dt)
             pending.append((step, metrics, time.time()))
+
+            if tracing_step is not None:
+                # one full step per window; fetch a scalar first so the
+                # trace covers the device work, not just the dispatch
+                float(metrics["loss"])
+                jax.profiler.stop_trace()
+                tracing_step = None
 
             if step % log_every == 0:
                 flush(time.time())

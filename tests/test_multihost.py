@@ -42,6 +42,19 @@ from distributedmnist_tpu.train.loop import Trainer
 
 cfg = ExperimentConfig.from_dict(json.loads(os.environ["DML_CFG"]))
 t = Trainer(cfg)
+sleep_ms = float(os.environ.get("DML_SLEEP_MS", "0"))
+if sleep_ms:
+    # a REAL slowdown of this process's step loop (not a configured
+    # delay constant): every batch fetch stalls the host, exactly like
+    # slow ingest or CPU contention would — the measured-timing path
+    # must observe it and the policies must act on it
+    import time as _time
+    _base_iter = t.train_iter
+    def _slow(it, secs):
+        while True:
+            _time.sleep(secs)
+            yield next(it)
+    t.train_iter = _slow(_base_iter, sleep_ms / 1000.0)
 start_step = t._start_step
 summary = t.run()
 ev = t.evaluate()
@@ -93,7 +106,7 @@ def _cfg_dict(train_dir: str) -> dict:
     }
 
 
-def _launch(tmp_path, cfg_dicts=None):
+def _launch(tmp_path, cfg_dicts=None, sleep_ms=(0.0, 0.0)):
     port = _free_port()
     procs = []
     for pid in range(2):
@@ -103,6 +116,7 @@ def _launch(tmp_path, cfg_dicts=None):
         env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
         env["JAX_NUM_PROCESSES"] = "2"
         env["JAX_PROCESS_ID"] = str(pid)
+        env["DML_SLEEP_MS"] = str(sleep_ms[pid])
         env["DML_CFG"] = json.dumps(
             cfg_dicts[pid] if cfg_dicts is not None
             else _cfg_dict(str(tmp_path / f"multihost_p{pid}")))
@@ -198,6 +212,38 @@ def test_two_process_quorum_gathers_on_every_host(tmp_path):
     t = Trainer(cfg)
     t.run(step_callback=lambda s, rec: records.append(rec))
     assert records[-1]["flags"] == r0["flags"]
+
+
+def test_slow_process_loses_quorum_by_measured_time(tmp_path):
+    """A REALLY slow process — its host loop stalled by an actual
+    sleep, not a configured delay — must lose quorum membership through
+    the measured-timing path: each process feeds its own measured step
+    time into its replicas' rows of the [n] vector
+    (Topology.device_put_measured), and the quorum policy ranks on it
+    (≙ measured per-worker times driving aggregation,
+    src/timeout_manager.py:48-61). k=4 of 8 with process 1 sleeping
+    250 ms per step ⇒ steady-state contributors are exactly process 0's
+    replicas 0–3."""
+    def qcfg(train_dir):
+        d = _cfg_dict(train_dir)
+        # straggler_profile "none" → the REAL measured host step time
+        # drives the policies (train/loop.py inject_measured)
+        d["sync"] = {"mode": "quorum", "num_replicas_to_aggregate": 4,
+                     "straggler_profile": "none"}
+        d["train"]["max_steps"] = 6
+        return d
+
+    r0, r1 = _launch(tmp_path, [qcfg(str(tmp_path / "s_p0")),
+                                qcfg(str(tmp_path / "s_p1"))],
+                     sleep_ms=(0.0, 250.0))
+    for r in (r0, r1):
+        assert r["num_contributors"] == 4.0
+        # process 1's measured times dwarf process 0's
+        times = r["last_step_times"]
+        assert min(times[4:]) > 10 * max(times[0], 1e-3), times
+        # ... and exactly its replicas are evicted from the quorum
+        assert r["flags"] == [1, 1, 1, 1, 0, 0, 0, 0]
+    assert r0["flags"] == r1["flags"]
 
 
 def test_two_process_save_kill_resume(tmp_path):

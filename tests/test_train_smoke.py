@@ -49,3 +49,33 @@ def test_metrics_shapes(tmp_train_dir, synthetic_datasets, topo8):
     assert m.shape == (3, topo8.num_replicas)
     assert np.all(m >= 0)
     assert summary["timing"]["barrier"]["count"] == 3
+
+
+def test_trace_every_steps_dumps_per_window(tmp_train_dir,
+                                            synthetic_datasets):
+    """train.trace_every_steps writes one profiler trace per cadence
+    window under profile/step_<k> (≙ --timeline_logging's per-iteration
+    trace dumps, src/distributed_train.py:354-358)."""
+    from pathlib import Path
+
+    t = make_trainer(tmp_train_dir, synthetic_datasets,
+                     train={"max_steps": 5, "log_every_steps": 5,
+                            "trace_every_steps": 2})
+    t.run()
+    windows = sorted(p.name for p in
+                     (Path(tmp_train_dir) / "profile").iterdir())
+    assert windows == ["step_0", "step_2", "step_4"]
+    for w in windows:  # each window holds a real trace artifact
+        dumped = list((Path(tmp_train_dir) / "profile" / w).rglob("*"))
+        assert any(p.is_file() for p in dumped), w
+
+
+def test_trace_and_profile_window_conflict(tmp_train_dir,
+                                           synthetic_datasets):
+    import pytest
+
+    t = make_trainer(tmp_train_dir, synthetic_datasets,
+                     train={"max_steps": 3, "profile_steps": (1, 2),
+                            "trace_every_steps": 2})
+    with pytest.raises(ValueError, match="not both"):
+        t.run()
