@@ -155,9 +155,16 @@ class MeshConfig:
     # Reserved axes so TP/SP can be added without redesign (SURVEY §5.7).
     model_parallelism: int = 1
     seq_parallelism: int = 1
-    # GPipe-style layer pipelining over the 'stage' axis.
+    # Layer pipelining over the 'stage' axis.
     pipeline_parallelism: int = 1
     pipeline_microbatches: int = 4
+    # "gpipe": all forwards then all backwards (AD transpose; bubble
+    # 2(S-1) stage-works). "1f1b": fused interleaved 1F1B — each stage
+    # split into pipeline_chunks virtual chunks, one chunk-work per
+    # device-tick, backward-priority schedule (ops/pipeline.py; bubble
+    # ~2(S-1) chunk-works, a pipeline_chunks-fold reduction).
+    pipeline_schedule: str = "gpipe"
+    pipeline_chunks: int = 1
     # Mixture-of-experts expert sharding over the 'expert' axis;
     # composes with model_parallelism (TP inside every expert's FFN and
     # the attention heads).

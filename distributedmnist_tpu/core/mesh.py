@@ -264,6 +264,13 @@ def make_topology(cfg: MeshConfig | None = None,
     size 1.
     """
     cfg = cfg or MeshConfig()
+    if cfg.pipeline_chunks > 1 and cfg.pipeline_schedule != "1f1b":
+        # chunks only exist under the interleaved schedule — silently
+        # ignoring them would hand back plain GPipe with its full
+        # bubble while the config promises interleaving
+        raise ValueError(
+            f"mesh.pipeline_chunks={cfg.pipeline_chunks} requires "
+            f"pipeline_schedule='1f1b' (got {cfg.pipeline_schedule!r})")
     if (devices is None and cfg.simulate_devices > 0
             and len(jax.devices()) < cfg.simulate_devices):
         # A config that trained on a simulated mesh must be loadable by

@@ -86,6 +86,16 @@ class Model:
     pp_param_specs: Callable[[str], Any] | None = None
     pp_apply_factory: (Callable[[str, int], Callable[..., jax.Array]]
                        | None) = None
+    # Interleaved-1F1B schedule support (mesh.pipeline_schedule="1f1b"):
+    # pp_transform_chunked(params, S, v) restacks into the
+    # chunk-interleaved layout; pp_1f1b_grads_factory(stage_axis, M, v)
+    # -> grads_fn(params, tokens, labels) -> (loss, acc, grads) (the
+    # fused forward/backward engine — no outer value_and_grad);
+    # pp_1f1b_apply_factory(stage_axis, M, v) -> apply for eval.
+    pp_transform_chunked: Callable[..., Any] | None = None
+    pp_1f1b_grads_factory: Callable[..., Callable[..., tuple]] | None = None
+    pp_1f1b_apply_factory: (Callable[..., Callable[..., jax.Array]]
+                            | None) = None
     # Auxiliary loss (MoE load balancing): when True, ``apply`` and the
     # sharded applies accept ``return_aux=True`` and return
     # (logits, aux); the train step adds ``aux_weight * aux``.
@@ -264,6 +274,31 @@ def _transformer(cfg: ModelConfig) -> Model:
                 compute_dtype=compute_dtype, remat=cfg.remat)
         return apply_pp
 
+    def pp_1f1b_grads_factory(stage_axis: str, num_microbatches: int,
+                              num_chunks: int):
+        if moe:
+            raise ValueError("mixture-of-experts does not yet compose with "
+                             "pipeline parallelism (aux loss cannot cross "
+                             "the stage pipeline)")
+
+        def grads_fn(params, tokens, labels):
+            return transformer.grads_pp_1f1b(
+                params, tokens, labels, num_heads=cfg.num_heads,
+                stage_axis=stage_axis, num_microbatches=num_microbatches,
+                num_chunks=num_chunks, attention_fn=attention_fn,
+                compute_dtype=compute_dtype)
+        return grads_fn
+
+    def pp_1f1b_apply_factory(stage_axis: str, num_microbatches: int,
+                              num_chunks: int):
+        def apply_1f1b(params, tokens):
+            return transformer.apply_pp_1f1b(
+                params, tokens, num_heads=cfg.num_heads,
+                stage_axis=stage_axis, num_microbatches=num_microbatches,
+                num_chunks=num_chunks, attention_fn=attention_fn,
+                compute_dtype=compute_dtype)
+        return apply_1f1b
+
     return Model(name=cfg.name, init=init, apply=apply,
                  loss=transformer.loss_fn, accuracy=transformer.accuracy,
                  input_shape=(cfg.seq_len,), input_dtype=jnp.int32,
@@ -275,4 +310,7 @@ def _transformer(cfg: ModelConfig) -> Model:
                          cfg.num_layers, axis, cfg.num_experts, expert_axis),
                  pp_transform=transformer.stack_block_params,
                  pp_param_specs=transformer.pp_param_partition_specs,
-                 pp_apply_factory=pp_apply_factory)
+                 pp_apply_factory=pp_apply_factory,
+                 pp_transform_chunked=transformer.stack_block_params_chunked,
+                 pp_1f1b_grads_factory=pp_1f1b_grads_factory,
+                 pp_1f1b_apply_factory=pp_1f1b_apply_factory)

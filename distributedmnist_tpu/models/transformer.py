@@ -319,6 +319,165 @@ def apply_pp(params: Params, tokens: jax.Array, *, num_heads: int,
     return logits.astype(jnp.float32)
 
 
+def stack_block_params_chunked(params: Params, num_stages: int,
+                               num_chunks: int) -> Params:
+    """Chunk-interleaved stacking for the 1F1B schedule: like
+    :func:`stack_block_params`, but layer ORDER is permuted so that the
+    contiguous stage shard of device ``d`` holds global chunks
+    ``{d, S+d, …, (v-1)·S+d}`` (slot-major: [slot j, layers of chunk
+    j·S+d]) — the placement the interleaved schedule's ring traversal
+    requires (ops/pipeline.py). Sharding specs are unchanged
+    (:func:`pp_param_partition_specs`); only the order differs.
+    """
+    blocks = params["blocks"]
+    L = len(blocks)
+    if L % (num_stages * num_chunks):
+        raise ValueError(
+            f"num_layers={L} not divisible by stages×chunks="
+            f"{num_stages}×{num_chunks}")
+    per = L // (num_stages * num_chunks)
+    order = [c * per + l
+             for d in range(num_stages)
+             for j in range(num_chunks)
+             for c in [j * num_stages + d]
+             for l in range(per)]
+    stacked = jax.tree.map(lambda *leaves: jnp.stack(leaves),
+                           *[blocks[i] for i in order])
+    return {**{k: v for k, v in params.items() if k != "blocks"},
+            "blocks": stacked}
+
+
+def grads_pp_1f1b(params: Params, tokens: jax.Array, labels: jax.Array, *,
+                  num_heads: int, stage_axis: str, num_microbatches: int,
+                  num_chunks: int, attention_fn: Callable | None = None,
+                  compute_dtype=jnp.bfloat16):
+    """Fused interleaved-1F1B training step body (inside shard_map,
+    params in the chunk-interleaved stacked layout of
+    :func:`stack_block_params_chunked`).
+
+    Unlike :func:`apply_pp` + AD (the GPipe path), forward and backward
+    chunk-works interleave inside ONE scan (ops/pipeline.py:
+    pipeline_1f1b_grads), shrinking the pipeline bubble by the chunk
+    factor; the backward recomputes each chunk from its saved input
+    (rematerialization built in). Embedding/positions run replicated
+    outside the pipeline; their gradients combine the lookup transpose
+    (via the banked input-cotangents) with the tied head's
+    contribution. Returns (loss, train_acc, grads) with ``grads``
+    matching the parameter layout.
+
+    TP/SP do not yet compose with this schedule (the GPipe path does);
+    the registry refuses those meshes up front.
+    """
+    from ..ops.pipeline import pipeline_1f1b_grads
+
+    attn = attention_fn or local_self_attention
+    b, s = tokens.shape
+    if b % num_microbatches != 0:
+        raise ValueError(f"batch {b} not divisible by "
+                         f"num_microbatches={num_microbatches}")
+    p = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+    d = p["embed"].shape[-1]
+    hd = d // num_heads
+    positions = jnp.arange(s)
+    mb = b // num_microbatches
+    M = num_microbatches
+
+    def emb_fn(embed, pos):
+        return (embed[tokens] + pos[positions]).reshape(M, mb, s, d)
+
+    micro, emb_vjp = jax.vjp(emb_fn, p["embed"], p["pos"])
+
+    L_local = jax.tree.leaves(p["blocks"])[0].shape[0]
+    per = L_local // num_chunks
+    chunk_params = jax.tree.map(
+        lambda a: a.reshape((num_chunks, per) + a.shape[1:]), p["blocks"])
+
+    def chunk_fn(slot_params, act):
+        def layer(carry, blk):
+            out, _aux = _apply_block(carry, blk, h_local=num_heads, hd=hd,
+                                     attn=attn, model_axis=None)
+            return out, None
+        out, _ = lax.scan(layer, act, slot_params)
+        return out
+
+    labels_mb = labels.reshape(M, mb, s)
+    head_params = {"embed": p["embed"], "final_norm": p["final_norm"]}
+
+    def head_fn(hp, y, m):
+        x = _rms_norm(y, hp["final_norm"])
+        logits = (x @ hp["embed"].T).astype(jnp.float32)
+        lab = lax.dynamic_index_in_dim(labels_mb, m, 0, keepdims=False)
+        return loss_fn(logits, lab), accuracy(logits, lab)
+
+    losses, accs, dinputs, dchunk, dhead = pipeline_1f1b_grads(
+        chunk_fn, head_fn, chunk_params, head_params, micro,
+        stage_axis, num_chunks)
+    # the engine seeds every microbatch's loss with cotangent 1.0 (sum
+    # convention); the step's loss is the MEAN over microbatches
+    scale = 1.0 / M
+    dinputs = dinputs * jnp.asarray(scale, dinputs.dtype)
+    dchunk = jax.tree.map(lambda a: a * jnp.asarray(scale, a.dtype), dchunk)
+    dhead = jax.tree.map(lambda a: a * jnp.asarray(scale, a.dtype), dhead)
+
+    demb_lookup, dpos = emb_vjp(dinputs.astype(micro.dtype))
+    grads = {
+        "embed": demb_lookup + dhead["embed"],  # lookup + tied head
+        "pos": dpos,
+        "blocks": jax.tree.map(
+            lambda a: a.reshape((L_local,) + a.shape[2:]), dchunk),
+        "final_norm": dhead["final_norm"],
+    }
+    # the engine differentiates the compute-dtype cast of the params;
+    # apply the cast's transpose so grads match the master param dtypes
+    grads = jax.tree.map(lambda g, p0: g.astype(p0.dtype), grads, params)
+    return jnp.mean(losses), jnp.mean(accs), grads
+
+
+def apply_pp_1f1b(params: Params, tokens: jax.Array, *, num_heads: int,
+                  stage_axis: str, num_microbatches: int, num_chunks: int,
+                  attention_fn: Callable | None = None,
+                  compute_dtype=jnp.bfloat16) -> jax.Array:
+    """Forward-only apply for the chunk-interleaved layout (eval under
+    schedule="1f1b"): the chunked ring (ops/pipeline.py:
+    pipeline_chunked_forward) with embedding/head outside, same
+    contract as :func:`apply_pp`."""
+    from ..ops.pipeline import pipeline_chunked_forward
+
+    attn = attention_fn or local_self_attention
+    b, s = tokens.shape
+    if b % num_microbatches != 0:
+        raise ValueError(f"batch {b} not divisible by "
+                         f"num_microbatches={num_microbatches}")
+    p = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+    d = p["embed"].shape[-1]
+    hd = d // num_heads
+    x = p["embed"][tokens] + p["pos"][jnp.arange(s)]
+    mb = b // num_microbatches
+    micro = x.reshape(num_microbatches, mb, s, d)
+
+    L_local = jax.tree.leaves(p["blocks"])[0].shape[0]
+    per = L_local // num_chunks
+    chunk_params = jax.tree.map(
+        lambda a: a.reshape((num_chunks, per) + a.shape[1:]), p["blocks"])
+
+    def chunk_fn(act, slot):
+        from ..ops.pipeline import _index_pytree
+        slot_params = _index_pytree(chunk_params, slot)
+
+        def layer(carry, blk):
+            out, _aux = _apply_block(carry, blk, h_local=num_heads, hd=hd,
+                                     attn=attn, model_axis=None)
+            return out, None
+        out, _ = lax.scan(layer, act, slot_params)
+        return out
+
+    out = pipeline_chunked_forward(chunk_fn, micro, stage_axis, num_chunks)
+    x = out.reshape(b, s, d)
+    x = _rms_norm(x, p["final_norm"])
+    logits = x @ p["embed"].T
+    return logits.astype(jnp.float32)
+
+
 def loss_fn(logits: jax.Array, labels: jax.Array) -> jax.Array:
     """Next-token mean xent. ``labels`` are the input tokens; targets
     are labels shifted left (last position dropped)."""

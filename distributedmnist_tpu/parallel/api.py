@@ -111,7 +111,18 @@ def init_train_state(model: Model, cfg: ExperimentConfig,
         if getattr(model, "pp_transform", None) is None:
             raise ValueError(f"mesh has pipeline stages but model "
                              f"{model.name!r} has no pp_transform")
-        params = model.pp_transform(params)  # layer-stacked layout
+        if cfg.mesh.pipeline_schedule == "1f1b":
+            if getattr(model, "pp_transform_chunked", None) is None:
+                raise ValueError(
+                    f"pipeline_schedule='1f1b' but model {model.name!r} "
+                    "has no pp_transform_chunked")
+            # chunk-interleaved layer order: device d's contiguous
+            # stage shard holds global chunks {d, S+d, ...}
+            params = model.pp_transform_chunked(
+                params, topo.mesh.shape[topo.stage_axis],
+                cfg.mesh.pipeline_chunks)
+        else:
+            params = model.pp_transform(params)  # layer-stacked layout
     momentum = (jax.tree.map(jnp.zeros_like, params)
                 if cfg.optim.momentum > 0.0 else None)
     interval = cfg.sync.mode == "interval"
@@ -215,18 +226,42 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         raise ValueError("expert parallelism does not yet compose with "
                          "pipeline parallelism (aux loss cannot cross the "
                          "stage pipeline)")
+    pp_schedule = cfg.mesh.pipeline_schedule
+    if pp_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline_schedule {pp_schedule!r}")
+    pp_1f1b_grads_fn = None
     if n_stage > 1:
         if getattr(model, "pp_apply_factory", None) is None:
             raise ValueError(f"mesh has pipeline_parallelism={n_stage} but "
                              f"model {model.name!r} has no pipeline apply")
-        # PP outermost; TP (model axis) inside each stage; SP (seq
-        # axis) through the stage blocks' sharded attention — every
-        # (stage, seq) device runs the same tick schedule so the
-        # attention collectives stay lockstep inside the pipeline scan
-        pp_apply = model.pp_apply_factory(
-            stage_ax, cfg.mesh.pipeline_microbatches,
-            model_ax if n_model > 1 else None,
-            seq_ax if n_seq > 1 else None)
+        if pp_schedule == "1f1b":
+            # fused interleaved schedule (ops/pipeline.py): explicit
+            # forward/backward chunk-works in one scan — built below
+            # instead of value_and_grad. TP/SP collectives inside a
+            # chunk would have to run on every device every tick
+            # regardless of that device's scheduled work; the GPipe
+            # path composes them, this schedule refuses them for now.
+            if n_model > 1 or n_seq > 1:
+                raise ValueError(
+                    "pipeline_schedule='1f1b' does not compose with "
+                    "tensor/sequence parallelism yet (use 'gpipe')")
+            if getattr(model, "pp_1f1b_grads_factory", None) is None:
+                raise ValueError(f"model {model.name!r} has no 1f1b "
+                                 "pipeline support")
+            pp_1f1b_grads_fn = model.pp_1f1b_grads_factory(
+                stage_ax, cfg.mesh.pipeline_microbatches,
+                cfg.mesh.pipeline_chunks)
+            pp_apply = None
+        else:
+            # PP outermost; TP (model axis) inside each stage; SP (seq
+            # axis) through the stage blocks' sharded attention — every
+            # (stage, seq) device runs the same tick schedule so the
+            # attention collectives stay lockstep inside the pipeline
+            # scan
+            pp_apply = model.pp_apply_factory(
+                stage_ax, cfg.mesh.pipeline_microbatches,
+                model_ax if n_model > 1 else None,
+                seq_ax if n_seq > 1 else None)
     else:
         pp_apply = None
     sharded_apply = (model.sharded_apply_factory(
@@ -236,7 +271,8 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         else None)
     # The SP/PP loss paths do not thread a dropout key; refuse loudly
     # instead of silently training a dropout model without dropout.
-    if ((sharded_apply is not None or pp_apply is not None)
+    if ((sharded_apply is not None or pp_apply is not None
+            or pp_1f1b_grads_fn is not None)
             and getattr(model, "uses_dropout", False)):
         raise ValueError(
             f"model {model.name!r} uses dropout, but the sharded "
@@ -342,6 +378,11 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
             loss = lax.psum(loss_p, seq_ax)
             train_acc = lax.psum(acc_p, seq_ax)
             grads = jax.tree.map(lambda g: lax.psum(g, seq_ax), grads)
+        elif pp_1f1b_grads_fn is not None:
+            # fused 1F1B: the engine computes loss, accuracy and grads
+            # in one interleaved scan — no outer value_and_grad
+            loss, train_acc, grads = pp_1f1b_grads_fn(
+                local_params, batch["image"], batch["label"])
         elif pp_apply is not None:
             (loss, logits), grads = jax.value_and_grad(
                 local_loss_pp, has_aux=True)(local_params, batch, dkey)
@@ -509,7 +550,16 @@ def build_eval_step(model: Model, cfg: ExperimentConfig, topo: Topology):
                              f"model {model.name!r} has no pipeline apply")
         tp_ax = model_ax if n_model > 1 else None
         pspec: Any = model.pp_param_specs(topo.stage_axis, tp_ax)
-        eval_pp_apply = model.pp_apply_factory(topo.stage_axis, 1, tp_ax)
+        if cfg.mesh.pipeline_schedule == "1f1b":
+            if n_model > 1:  # same refusal the train path makes
+                raise ValueError(
+                    "pipeline_schedule='1f1b' does not compose with "
+                    "tensor parallelism yet (use 'gpipe')")
+            # chunk-interleaved param layout → the chunked-ring apply
+            eval_pp_apply = model.pp_1f1b_apply_factory(
+                topo.stage_axis, 1, cfg.mesh.pipeline_chunks)
+        else:
+            eval_pp_apply = model.pp_apply_factory(topo.stage_axis, 1, tp_ax)
 
         def run(params, images):
             return eval_pp_apply(params, images)

@@ -121,6 +121,22 @@ class Trainer:
         if restored is None:
             return
         state, extra, step = restored
+        # The gpipe layer-stacked and 1f1b chunk-interleaved layouts
+        # have identical tree structure and leaf shapes but DIFFERENT
+        # layer order — a shape-matched restore across schedules would
+        # silently permute the model. Refuse instead.
+        saved_mesh = (extra.get("config") or {}).get("mesh", {})
+        if self.topo.mesh.shape[self.topo.stage_axis] > 1:
+            saved = (saved_mesh.get("pipeline_schedule", "gpipe"),
+                     saved_mesh.get("pipeline_chunks", 1))
+            want = (self.cfg.mesh.pipeline_schedule,
+                    self.cfg.mesh.pipeline_chunks)
+            if saved != want:
+                raise ValueError(
+                    f"checkpoint was written with pipeline layout "
+                    f"(schedule, chunks)={saved} but this run uses "
+                    f"{want}; the stacked layer orders differ — "
+                    "restoring would silently permute the model")
         self.state = self.topo.device_put_state(state, self.state_specs)
         if "data_iter" in extra:
             try:
