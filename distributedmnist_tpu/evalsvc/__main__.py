@@ -18,13 +18,18 @@ def main(argv=None) -> None:
     p.add_argument("--eval_batch_size", type=int, default=0)
     p.add_argument("--run_once", action="store_true")
     p.add_argument("--max_evals", type=int, default=0)
+    p.add_argument("--single_device", action="store_true",
+                   help="evaluate on ONE ambient device regardless of the "
+                        "training mesh (DP checkpoints only) — the lean "
+                        "co-located mode: no collectives to starve while "
+                        "sharing a host with the trainer")
     args = p.parse_args(argv)
 
     ecfg = EvalConfig(eval_interval_secs=args.eval_interval_secs,
                       eval_dir=args.eval_dir,
                       eval_batch_size=args.eval_batch_size,
                       run_once=args.run_once, max_evals=args.max_evals)
-    Evaluator(args.train_dir, ecfg).run()
+    Evaluator(args.train_dir, ecfg, single_device=args.single_device).run()
 
 
 if __name__ == "__main__":

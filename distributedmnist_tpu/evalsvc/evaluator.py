@@ -20,7 +20,9 @@ from __future__ import annotations
 import time
 from pathlib import Path
 
-from ..core.config import EvalConfig, ExperimentConfig
+import jax
+
+from ..core.config import EvalConfig, ExperimentConfig, MeshConfig
 from ..core.log import JsonlSink, eval_line, get_logger
 from ..core.mesh import Topology, make_topology
 from ..data.datasets import Datasets, load_datasets
@@ -39,13 +41,36 @@ class Evaluator:
     def __init__(self, train_dir: str | Path, eval_cfg: EvalConfig | None = None,
                  cfg: ExperimentConfig | None = None,
                  topo: Topology | None = None,
-                 datasets: Datasets | None = None):
+                 datasets: Datasets | None = None,
+                 single_device: bool = False):
         self.train_dir = Path(train_dir)
         self.eval_cfg = eval_cfg or EvalConfig()
         if cfg is None:
             cfg = self._config_from_checkpoint()
         self.cfg = cfg
-        self.topo = topo or make_topology(cfg.mesh)
+        if topo is not None:
+            self.topo = topo
+        elif single_device:
+            # Lean mesh for co-located evaluation: ONE ambient device,
+            # regardless of the training mesh (incl. simulate_devices
+            # configs — no forced N-device backend, no collectives, no
+            # rendezvous to starve while sharing a host with the
+            # trainer; the campaign's live oracle runs this way).
+            # Params of a data-parallel run are replicated, so the
+            # restore is shape-identical; model-sharded layouts are not
+            # reconstructible on one device — refuse those.
+            m = cfg.mesh
+            if (m.model_parallelism > 1 or m.seq_parallelism > 1
+                    or m.pipeline_parallelism > 1
+                    or m.expert_parallelism > 1):
+                raise ValueError(
+                    "single_device evaluation supports data-parallel "
+                    "checkpoints only (params replicated); this run has "
+                    "model/seq/stage/expert parallelism")
+            self.topo = make_topology(MeshConfig(num_replicas=1),
+                                      devices=jax.devices()[:1])
+        else:
+            self.topo = make_topology(cfg.mesh)
         self.model = get_model(cfg.model)
         self.datasets = datasets if datasets is not None else load_datasets(
             cfg.data, cfg.model.image_size, cfg.model.num_channels,

@@ -57,7 +57,8 @@ def _eval(args) -> None:
     ecfg = EvalConfig(eval_interval_secs=args.eval_interval_secs,
                       eval_dir=args.eval_dir, run_once=args.run_once,
                       max_evals=args.max_evals)
-    evalsvc.Evaluator(args.train_dir, ecfg).run()
+    evalsvc.Evaluator(args.train_dir, ecfg,
+                      single_device=args.single_device).run()
 
 
 def _sweep(args) -> None:
@@ -120,6 +121,10 @@ def main(argv=None) -> None:
     pe.add_argument("--eval_interval_secs", type=float, default=1.0)
     pe.add_argument("--run_once", action="store_true")
     pe.add_argument("--max_evals", type=int, default=0)
+    pe.add_argument("--single_device", action="store_true",
+                    help="evaluate on ONE ambient device regardless of the "
+                         "training mesh (DP checkpoints only; the lean "
+                         "co-located mode)")
     pe.set_defaults(fn=_eval)
 
     ps = sub.add_parser("sweep", help="run a directory of experiment configs")

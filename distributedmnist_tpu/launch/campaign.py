@@ -31,6 +31,8 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import re
 import subprocess
 import sys
 import time
@@ -168,16 +170,41 @@ def run_group(group: str, names: list[str], results_dir: Path,
 
 def start_evaluator(run_dir: Path) -> subprocess.Popen:
     """Launch the continuous evaluator against a run's train dir — the
-    reference's separate evaluator machine (tools/tf_ec2.py:130-146)."""
+    reference's separate evaluator machine (tools/tf_ec2.py:130-146).
+
+    Runs --single_device under ``nice -n 19``: on a shared host the
+    trainer's N-device collectives abort hard (XLA's 40 s rendezvous
+    termination) if another full-mesh process starves them — measured
+    twice on the 1-core box before this. A one-device,
+    lowest-priority evaluator has no collectives of its own and only
+    runs in the trainer's host-side gaps. (``nice`` as a command
+    prefix, NOT preexec_fn: forking this multithreaded JAX parent and
+    running Python pre-exec can deadlock the child.)
+
+    The child's env is scrubbed of the parent's forced-mesh settings
+    (simulate_devices mutates XLA_FLAGS/JAX_PLATFORMS process-wide) so
+    the evaluator boots the true AMBIENT backend — one real device,
+    not N virtual CPU devices it would immediately discard."""
     run_dir.mkdir(parents=True, exist_ok=True)
     eval_dir = run_dir / "eval"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", "")).strip()
+    if flags:
+        env["XLA_FLAGS"] = flags
+    else:
+        env.pop("XLA_FLAGS", None)
     with open(run_dir / "evaluator_stdout.log", "w") as log:
         proc = subprocess.Popen(
-            [sys.executable, "-m", "distributedmnist_tpu.launch", "eval",
+            ["nice", "-n", "19",
+             sys.executable, "-m", "distributedmnist_tpu.launch", "eval",
              "--train_dir", str(run_dir / "train"),
              "--eval_dir", str(eval_dir),
-             "--eval_interval_secs", "2.0"],
-            stdout=log, stderr=subprocess.STDOUT)  # child keeps its dup
+             "--eval_interval_secs", "2.0",
+             "--single_device"],
+            stdout=log, stderr=subprocess.STDOUT,  # child keeps its dup
+            env=env)
     logger.info("evaluator pid %d watching %s", proc.pid, run_dir / "train")
     return proc
 

@@ -51,6 +51,32 @@ def test_evaluator_skips_unchanged_step(tmp_train_dir, synthetic_datasets, tmp_p
     assert ckpt.latest_checkpoint_step(tmp_train_dir) == ev.last_step_evaluated
 
 
+def test_evaluator_single_device_mode(tmp_train_dir, synthetic_datasets,
+                                      tmp_path):
+    """The lean co-located mode: a data-parallel checkpoint evaluates
+    on ONE ambient device (no forced mesh, no collectives), matching
+    the full-mesh evaluation; model-sharded configs are refused."""
+    import pytest
+
+    from distributedmnist_tpu.core.config import EvalConfig
+    from distributedmnist_tpu.evalsvc import Evaluator
+    cfg = _train(tmp_train_dir, synthetic_datasets, steps=120)
+    ecfg = EvalConfig(eval_dir=str(tmp_path / "eval"), run_once=True,
+                      eval_interval_secs=0.01)
+    ev = Evaluator(tmp_train_dir, ecfg, cfg=cfg, datasets=synthetic_datasets,
+                   single_device=True)
+    assert ev.topo.num_replicas == 1
+    assert len(ev.topo.mesh.devices.flatten()) == 1
+    results = ev.run()
+    assert results[0]["step"] == 120
+    assert results[0]["precision_at_1"] >= 0.99
+
+    pp_cfg = cfg.override({"mesh.pipeline_parallelism": 2})
+    with pytest.raises(ValueError, match="single_device"):
+        Evaluator(tmp_train_dir, ecfg, cfg=pp_cfg,
+                  datasets=synthetic_datasets, single_device=True)
+
+
 def test_evaluator_adopts_checkpoint_config(tmp_train_dir, synthetic_datasets, tmp_path):
     """The evaluator rebuilds the exact trainer config from the
     checkpoint itself — no trainer/evaluator graph skew."""
