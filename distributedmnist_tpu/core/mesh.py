@@ -104,6 +104,23 @@ def simulate_devices(n: int) -> None:
         pass  # backend already initialized; XLA_FLAGS path applies
 
 
+def strip_forced_platform_env(env: dict) -> dict:
+    """Undo :func:`simulate_devices`' env mutations in a CHILD's env so
+    a subprocess boots the true ambient backend (the campaign's lean
+    single-device evaluator). Kept here, next to the code that writes
+    the flag, so the two can't drift."""
+    import re
+    env = dict(env)
+    env.pop("JAX_PLATFORMS", None)
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", "")).strip()
+    if flags:
+        env["XLA_FLAGS"] = flags
+    else:
+        env.pop("XLA_FLAGS", None)
+    return env
+
+
 _ambient_mesh: tuple[int, str] | None = None  # (device_count, platform)
 
 

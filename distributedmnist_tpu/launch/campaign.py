@@ -32,7 +32,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import re
 import subprocess
 import sys
 import time
@@ -185,16 +184,10 @@ def start_evaluator(run_dir: Path) -> subprocess.Popen:
     (simulate_devices mutates XLA_FLAGS/JAX_PLATFORMS process-wide) so
     the evaluator boots the true AMBIENT backend — one real device,
     not N virtual CPU devices it would immediately discard."""
+    from ..core.mesh import strip_forced_platform_env
     run_dir.mkdir(parents=True, exist_ok=True)
     eval_dir = run_dir / "eval"
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                   env.get("XLA_FLAGS", "")).strip()
-    if flags:
-        env["XLA_FLAGS"] = flags
-    else:
-        env.pop("XLA_FLAGS", None)
+    env = strip_forced_platform_env(os.environ)
     with open(run_dir / "evaluator_stdout.log", "w") as log:
         proc = subprocess.Popen(
             ["nice", "-n", "19",
