@@ -262,21 +262,25 @@ def bench_flash_long_context() -> None:
     # rate alongside
     flops_exec = 4 * fwd_per_token * B * S * timed
     tflops = flops_exec / dt / 1e12 / n_dev
-    _case({"metric": "flash_long_context_train_tflops_per_chip",
-           "value": round(tflops, 2),
-           "unit": "TFLOP/s/chip",
-           "vs_baseline": _vs(tflops,
-                              _published("flash_long_context_tflops_per_chip"),
-                              "flash_long_context_tflops_per_chip"),
-           "detail": {"dims": {"d": d, "L": L, "H": H, "S": S, "B": B},
-                      "attention_fraction": round(
-                          2 * S / (24 * d + 2 * S + 2 * V / L), 3),
-                      "model_tflops_per_chip": round(
-                          3 * fwd_per_token * B * S * timed / dt / 1e12
-                          / n_dev, 2),
-                      "tokens_per_sec": round(timed * B * S / dt, 1),
-                      "compile_s": round(compile_s, 2),
-                      **_env_stamp()}})
+    vs = _vs(tflops, _published("flash_long_context_tflops_per_chip"),
+             "flash_long_context_tflops_per_chip")
+    record = {"metric": "flash_long_context_train_tflops_per_chip",
+              "value": round(tflops, 2),
+              "unit": "TFLOP/s/chip",
+              "vs_baseline": vs,
+              "detail": {
+                  "dims": {"d": d, "L": L, "H": H, "S": S, "V": V, "B": B},
+                  "attention_fraction": round(
+                      2 * S / (24 * d + 2 * S + 2 * V / L), 3),
+                  "model_tflops_per_chip": round(
+                      3 * fwd_per_token * B * S * timed / dt / 1e12
+                      / n_dev, 2),
+                  "tokens_per_sec": round(timed * B * S / dt, 1),
+                  "compile_s": round(compile_s, 2),
+                  **_env_stamp()}}
+    if vs is not None and vs < 0.5:
+        record["degraded"] = True
+    _case(record)
 
 
 def bench_mode_overhead() -> None:

@@ -171,14 +171,17 @@ def start_evaluator(run_dir: Path) -> subprocess.Popen:
     """Launch the continuous evaluator against a run's train dir — the
     reference's separate evaluator machine (tools/tf_ec2.py:130-146).
 
-    Runs --single_device under ``nice -n 19``: on a shared host the
+    Runs --single_device under ``nice -n 5``: on a shared host the
     trainer's N-device collectives abort hard (XLA's 40 s rendezvous
     termination) if another full-mesh process starves them — measured
-    twice on the 1-core box before this. A one-device,
-    lowest-priority evaluator has no collectives of its own and only
-    runs in the trainer's host-side gaps. (``nice`` as a command
-    prefix, NOT preexec_fn: forking this multithreaded JAX parent and
-    running Python pre-exec can deadlock the child.)
+    twice on the 1-core box before this. A one-device evaluator has no
+    collectives of its own and cannot starve the trainer's (one
+    runnable thread against the trainer's N at higher weight), while
+    nice 19 was measured to starve the EVALUATOR into uselessness
+    (~5% of the core: 25 min to merely boot against a 50-device
+    trainer) — 5 is the balance. (``nice`` as a command prefix, NOT
+    preexec_fn: forking this multithreaded JAX parent and running
+    Python pre-exec can deadlock the child.)
 
     The child's env is scrubbed of the parent's forced-mesh settings
     (simulate_devices mutates XLA_FLAGS/JAX_PLATFORMS process-wide) so
@@ -190,7 +193,7 @@ def start_evaluator(run_dir: Path) -> subprocess.Popen:
     env = strip_forced_platform_env(os.environ)
     with open(run_dir / "evaluator_stdout.log", "w") as log:
         proc = subprocess.Popen(
-            ["nice", "-n", "19",
+            ["nice", "-n", "5",
              sys.executable, "-m", "distributedmnist_tpu.launch", "eval",
              "--train_dir", str(run_dir / "train"),
              "--eval_dir", str(eval_dir),
@@ -234,6 +237,10 @@ def finalize(results_dir: Path) -> None:
             continue
         records = [json.loads(l) for l in f.read_text().splitlines()
                    if l.strip()]
+        # a rerun APPENDS to the group's jsonl (the full history stays
+        # on disk); reports and the summary reflect each experiment's
+        # LATEST record only
+        records = list({r.get("name"): r for r in records}.values())
         write_report(records, gdir)
         summary[gdir.name] = [{k: r.get(k) for k in
                                ("name", "test_accuracy", "examples_per_sec",

@@ -171,7 +171,14 @@ class Trainer:
     def _sink_write(self, record: dict) -> None:
         if self.is_writer:
             if self._sink is None:
-                self._sink = JsonlSink(self.train_dir / "train_log.jsonl")
+                log_path = self.train_dir / "train_log.jsonl"
+                if self._start_step == 0 and log_path.exists():
+                    # fresh run (not a resume) into a reused train_dir:
+                    # starting over must not concatenate onto an older
+                    # run's step series — every report/figure consumer
+                    # reads this file as ONE monotone series
+                    log_path.unlink()
+                self._sink = JsonlSink(log_path)
             self._sink.write(record)
 
     def _dump_series(self) -> None:

@@ -51,6 +51,32 @@ def test_metrics_shapes(tmp_train_dir, synthetic_datasets, topo8):
     assert summary["timing"]["barrier"]["count"] == 3
 
 
+def test_fresh_run_truncates_train_log(tmp_train_dir, synthetic_datasets):
+    """A NON-resumed run into a reused train_dir must not concatenate
+    its step series onto the previous run's train_log.jsonl (reports
+    read the file as one monotone series); a resumed run appends."""
+    import json
+    from pathlib import Path
+
+    log = Path(tmp_train_dir) / "train_log.jsonl"
+    make_trainer(tmp_train_dir, synthetic_datasets,
+                 train={"max_steps": 4, "log_every_steps": 2}).run()
+    n_first = len(log.read_text().splitlines())
+
+    # fresh rerun (resume off): old series replaced, steps restart at 1
+    make_trainer(tmp_train_dir, synthetic_datasets,
+                 train={"max_steps": 4, "log_every_steps": 2,
+                        "resume": False}).run()
+    steps = [json.loads(l)["step"] for l in log.read_text().splitlines()]
+    assert len(steps) == n_first and steps[0] == 1
+
+    # resumed run: appends, series stays monotone
+    make_trainer(tmp_train_dir, synthetic_datasets,
+                 train={"max_steps": 6, "log_every_steps": 2}).run()
+    steps = [json.loads(l)["step"] for l in log.read_text().splitlines()]
+    assert steps == sorted(steps) and steps[-1] == 6
+
+
 def test_trace_every_steps_dumps_per_window(tmp_train_dir,
                                             synthetic_datasets):
     """train.trace_every_steps writes one profiler trace per cadence
