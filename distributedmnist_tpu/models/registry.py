@@ -258,28 +258,37 @@ def _transformer(cfg: ModelConfig) -> Model:
 
     def pp_apply_factory(stage_axis: str, num_microbatches: int,
                          model_axis: str | None = None,
-                         seq_axis: str | None = None):
-        if moe:
-            raise ValueError("mixture-of-experts does not yet compose with "
-                             "pipeline parallelism (aux loss cannot cross "
-                             "the stage pipeline)")
+                         seq_axis: str | None = None,
+                         expert_axis: str | None = None):
+        if moe and seq_axis is not None:
+            raise ValueError(
+                "PP×SP with mixture-of-experts is not supported (the SP "
+                "partial-loss path does not thread the aux loss)")
+        if expert_axis is not None and not moe:
+            raise ValueError("mesh has expert parallelism but the model has "
+                             "no experts (model.num_experts == 0)")
         pp_attn = make_seq_attn(seq_axis)
 
-        def apply_pp(params, tokens, positions=None):
+        def apply_pp(params, tokens, positions=None, return_aux=False):
             return transformer.apply_pp(
                 params, tokens, num_heads=cfg.num_heads,
                 stage_axis=stage_axis, num_microbatches=num_microbatches,
                 attention_fn=pp_attn, positions=positions,
-                model_axis=model_axis,
-                compute_dtype=compute_dtype, remat=cfg.remat)
+                model_axis=model_axis, expert_axis=expert_axis,
+                num_experts=cfg.num_experts,
+                capacity_factor=cfg.expert_capacity_factor,
+                compute_dtype=compute_dtype, remat=cfg.remat,
+                return_aux=return_aux)
         return apply_pp
 
     def pp_1f1b_grads_factory(stage_axis: str, num_microbatches: int,
                               num_chunks: int):
         if moe:
-            raise ValueError("mixture-of-experts does not yet compose with "
-                             "pipeline parallelism (aux loss cannot cross "
-                             "the stage pipeline)")
+            raise ValueError(
+                "mixture-of-experts does not compose with the 1f1b "
+                "pipeline schedule yet (the fused engine does not "
+                "accumulate routing statistics); use "
+                "mesh.pipeline_schedule='gpipe', which supports MoE")
 
         def grads_fn(params, tokens, labels):
             return transformer.grads_pp_1f1b(
@@ -309,7 +318,9 @@ def _transformer(cfg: ModelConfig) -> Model:
                      transformer.param_partition_specs(
                          cfg.num_layers, axis, cfg.num_experts, expert_axis),
                  pp_transform=transformer.stack_block_params,
-                 pp_param_specs=transformer.pp_param_partition_specs,
+                 pp_param_specs=lambda stage_axis, model_axis=None,
+                 expert_axis=None: transformer.pp_param_partition_specs(
+                     stage_axis, model_axis, cfg.num_experts, expert_axis),
                  pp_apply_factory=pp_apply_factory,
                  pp_transform_chunked=transformer.stack_block_params_chunked,
                  pp_1f1b_grads_factory=pp_1f1b_grads_factory,

@@ -184,10 +184,13 @@ def _apply_block(x: jax.Array, blk: Params, *, h_local: int, hd: int,
                  attn: Callable, model_axis: str | None,
                  expert_axis: str | None = None, num_experts: int = 0,
                  capacity_factor: float = 1.25,
-                 moe_stats_axes: tuple[str, ...] = ()) -> tuple[jax.Array, jax.Array]:
+                 moe_stats_axes: tuple[str, ...] = (),
+                 moe_return_stats: bool = False) -> tuple[jax.Array, jax.Array]:
     """One pre-norm transformer block (shared by the dense/TP loop and
     the pipeline stage scan). Returns (x, moe_aux_loss) — aux is 0 for
-    dense-FFN blocks."""
+    dense-FFN blocks. With ``moe_return_stats`` the second element is
+    the raw routing statistics pair instead (the pipeline accumulates
+    them across microbatch ticks before forming the aux)."""
     b = x.shape[0]
     h = _rms_norm(x, blk["ln1"])
     qkv = jnp.einsum("bsd,dte->bste", h, blk["wqkv"])  # e = d/m
@@ -210,7 +213,8 @@ def _apply_block(x: jax.Array, blk: Params, *, h_local: int, hd: int,
                            capacity_factor=capacity_factor,
                            expert_axis=expert_axis,
                            tp_axis=model_axis,
-                           stats_axes=moe_stats_axes)
+                           stats_axes=moe_stats_axes,
+                           return_stats=moe_return_stats)
     else:
         mlp = jax.nn.relu(h @ blk["w1"]) @ blk["w2"]
         aux = jnp.zeros((), jnp.float32)
@@ -234,7 +238,9 @@ def stack_block_params(params: Params) -> Params:
 
 
 def pp_param_partition_specs(stage_axis: str,
-                             model_axis: str | None = None) -> Params:
+                             model_axis: str | None = None,
+                             num_experts: int = 0,
+                             expert_axis: str | None = None) -> Params:
     """Stacked-layout specs: block leaves sharded on the layer dim over
     the stage axis; embeddings/norms replicated (their gradients psum
     over stages via the AD transpose of the replication).
@@ -242,15 +248,27 @@ def pp_param_partition_specs(stage_axis: str,
     ``model_axis`` composes Megatron TP inside each stage: the same
     column/row dims as :func:`param_partition_specs`, one position to
     the right of the stacked layer dim (PP outermost, TP within the
-    stage's layer slice)."""
+    stage's layer slice). ``expert_axis`` (MoE, num_experts > 0)
+    additionally shards each block's expert dim — PP picks the layer,
+    EP the expert, TP the expert's hidden slice."""
     P = PartitionSpec
     m = model_axis  # None → replicated on the TP dims
-    blk = {"ln1": {"scale": P(stage_axis)},
-           "wqkv": P(stage_axis, None, None, m),
-           "wo": P(stage_axis, m, None),
-           "ln2": {"scale": P(stage_axis)},
-           "w1": P(stage_axis, None, m),
-           "w2": P(stage_axis, m, None)}
+    if num_experts > 0:
+        e = expert_axis
+        blk = {"ln1": {"scale": P(stage_axis)},
+               "wqkv": P(stage_axis, None, None, m),
+               "wo": P(stage_axis, m, None),
+               "ln2": {"scale": P(stage_axis)},
+               "router": P(stage_axis),
+               "w1": P(stage_axis, e, None, m),
+               "w2": P(stage_axis, e, m, None)}
+    else:
+        blk = {"ln1": {"scale": P(stage_axis)},
+               "wqkv": P(stage_axis, None, None, m),
+               "wo": P(stage_axis, m, None),
+               "ln2": {"scale": P(stage_axis)},
+               "w1": P(stage_axis, None, m),
+               "w2": P(stage_axis, m, None)}
     return {"embed": P(), "pos": P(), "blocks": blk,
             "final_norm": {"scale": P()}}
 
@@ -260,7 +278,10 @@ def apply_pp(params: Params, tokens: jax.Array, *, num_heads: int,
              attention_fn: Callable | None = None,
              positions: jax.Array | None = None,
              model_axis: str | None = None,
-             compute_dtype=jnp.bfloat16, remat: bool = False) -> jax.Array:
+             expert_axis: str | None = None, num_experts: int = 0,
+             capacity_factor: float = 1.25,
+             compute_dtype=jnp.bfloat16, remat: bool = False,
+             return_aux: bool = False) -> jax.Array:
     """Pipeline-parallel forward (inside shard_map, params in the
     stacked layout with block leaves sharded over ``stage_axis``).
 
@@ -280,6 +301,17 @@ def apply_pp(params: Params, tokens: jax.Array, *, num_heads: int,
     seq axis) and this shard's global positions; every (stage, seq)
     device runs the same tick schedule, so the attention collectives
     stay lockstep inside the pipeline scan — bubbles included.
+
+    Mixture-of-experts (``num_experts > 0``, optionally expert-sharded
+    over ``expert_axis``) composes too: each tick's MoE calls run the
+    grouped dispatch on that microbatch's tokens (capacity is
+    microbatch-local — the shard-local-capacity norm of ops/moe.py),
+    all-to-alls lockstep across stages since every device runs every
+    tick. The aux loss cannot be summed per tick (E·Σ frac·mprob is
+    nonlinear in the statistics), so each block's RAW routing stats are
+    accumulated across the real microbatch ticks (pipeline_apply
+    ``with_stats``) and the aux is formed from the batch-mean stats —
+    exactly the dense full-batch value. ``return_aux`` returns it.
     """
     from ..ops.pipeline import pipeline_apply
 
@@ -301,22 +333,40 @@ def apply_pp(params: Params, tokens: jax.Array, *, num_heads: int,
     mb = b // num_microbatches
     micro = x.reshape(num_microbatches, mb, s, d)
 
+    moe = num_experts > 0
+
     def stage_fn(act):
         def layer(carry, blk):
-            out, _aux = _apply_block(carry, blk, h_local=num_heads // m,
-                                     hd=hd, attn=attn, model_axis=model_axis)
-            return out, None
+            out, st = _apply_block(carry, blk, h_local=num_heads // m,
+                                   hd=hd, attn=attn, model_axis=model_axis,
+                                   expert_axis=expert_axis,
+                                   num_experts=num_experts,
+                                   capacity_factor=capacity_factor,
+                                   moe_return_stats=moe)
+            return out, (st if moe else None)
 
         if remat:
             layer = jax.checkpoint(layer)
-        out, _ = lax.scan(layer, act, p["blocks"])
-        return out
+        out, stats = lax.scan(layer, act, p["blocks"])
+        # stats: per-layer (frac, mprob) [L_local, E] pairs (MoE only)
+        return (out, stats) if moe else out
 
-    out = pipeline_apply(stage_fn, micro, stage_axis)
+    if moe:
+        out, (fracs, mprobs) = pipeline_apply(stage_fn, micro, stage_axis,
+                                              with_stats=True)
+        # batch-mean stats per LOCAL layer → this stage's aux share;
+        # stages hold disjoint layers, so one psum totals the model
+        aux = lax.psum(
+            num_experts * jnp.sum(fracs.astype(jnp.float32)
+                                  * mprobs.astype(jnp.float32)),
+            stage_axis)
+    else:
+        out = pipeline_apply(stage_fn, micro, stage_axis)
+        aux = jnp.zeros((), jnp.float32)
     x = out.reshape(b, s, d)
     x = _rms_norm(x, p["final_norm"])
-    logits = x @ p["embed"].T
-    return logits.astype(jnp.float32)
+    logits = (x @ p["embed"].T).astype(jnp.float32)
+    return (logits, aux) if return_aux else logits
 
 
 def stack_block_params_chunked(params: Params, num_stages: int,

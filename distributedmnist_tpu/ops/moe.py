@@ -81,7 +81,8 @@ def moe_ffn(x: jax.Array, router_w: jax.Array, w1: jax.Array, w2: jax.Array,
             *, num_experts: int, capacity_factor: float = 1.25,
             expert_axis: str | None = None,
             tp_axis: str | None = None,
-            stats_axes: tuple[str, ...] = ()) -> tuple[jax.Array, jax.Array]:
+            stats_axes: tuple[str, ...] = (),
+            return_stats: bool = False) -> tuple[jax.Array, jax.Array]:
     """Top-1 routed expert FFN.
 
     Args (inside shard_map when ``expert_axis``/``tp_axis`` are set):
@@ -103,10 +104,17 @@ def moe_ffn(x: jax.Array, router_w: jax.Array, w1: jax.Array, w2: jax.Array,
       stats_axes: extra mesh axes whose token shards the load-balance
         statistics must average over (the seq axis under SP), so the
         aux loss matches the dense full-token computation exactly.
+      return_stats: return the RAW averaged routing statistics
+        ``(frac, mean_prob)`` (each [E]) instead of the aux scalar —
+        for callers that see only a token SLICE per call (the pipeline
+        processing one microbatch per tick) and must average the
+        statistics across calls BEFORE forming the aux product, since
+        E·Σ frac·mprob is not linear in the statistics.
 
     Returns (out [batch, seq, d], aux): ``aux`` is the Switch
     load-balancing loss E·Σ_e(fraction_e · mean_prob_e), ≈1 when
     perfectly balanced; add ``aux_weight * aux`` to the train loss.
+    With ``return_stats``, (out, (frac [E], mean_prob [E])) instead.
     """
     b, s, d = x.shape
     t = b * s
@@ -163,5 +171,7 @@ def moe_ffn(x: jax.Array, router_w: jax.Array, w1: jax.Array, w2: jax.Array,
         # mean: aux computed from these equals the dense aux exactly
         frac = lax.pmean(frac, stat_axes)
         mprob = lax.pmean(mprob, stat_axes)
+    if return_stats:
+        return out.reshape(b, s, d), (frac, mprob)
     aux = e * jnp.sum(frac * mprob)
     return out.reshape(b, s, d), aux.astype(jnp.float32)

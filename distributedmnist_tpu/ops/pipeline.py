@@ -44,20 +44,28 @@ def _vary_to(full_vma: frozenset) -> Callable:
     return vary
 
 
-def pipeline_apply(stage_fn: Callable[[jax.Array], jax.Array],
-                   microbatches: jax.Array, axis_name: str) -> jax.Array:
+def pipeline_apply(stage_fn: Callable, microbatches: jax.Array,
+                   axis_name: str, with_stats: bool = False):
     """Run sharded-by-layer ``stage_fn`` as a microbatch pipeline.
 
     Args:
       stage_fn: applies THIS device's layer slice:
         activations [mb, ...] → activations [mb, ...] (same shape).
+        With ``with_stats``, returns (activations, stats_pytree) — the
+        stats (e.g. MoE routing statistics) are accumulated over the
+        REAL microbatch ticks only (bubble ticks chew zeros whose
+        routing stats are garbage) and returned averaged over the M
+        microbatches; with equal-size microbatches that mean equals
+        the full-batch statistics exactly.
       microbatches: [M, mb, ...] — the embedded inputs; only stage 0's
         values are consumed (other stages may hold the same array).
       axis_name: the mesh stage axis (inside shard_map).
 
     Returns [M, mb, ...] final-stage outputs, REPLICATED over the stage
     axis (a masked psum broadcasts them), so downstream loss/head code
-    runs identically on every stage.
+    runs identically on every stage; with ``with_stats``, a tuple
+    (outputs, mean_stats) where mean_stats stays PER-STAGE (each
+    stage's own layers' statistics — the caller reduces across stages).
     """
     s = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
@@ -67,13 +75,26 @@ def pipeline_apply(stage_fn: Callable[[jax.Array], jax.Array],
     buf0 = jnp.where(me == 0, microbatches[0], jnp.zeros_like(microbatches[0]))
     outs0 = jnp.zeros_like(microbatches)
     # probe one stage application so carries match the scan body's vma
-    vary = _vary_to(_vma_of(stage_fn(buf0)))
+    ref = stage_fn(buf0)
+    ref_act, ref_stats = ref if with_stats else (ref, None)
+    vary = _vary_to(_vma_of(ref_act))
     buf0 = vary(buf0)
     outs0 = vary(outs0)
+    stats0 = (jax.tree.map(lambda r: vary(jnp.zeros_like(r)), ref_stats)
+              if with_stats else None)
 
     def tick(carry, t):
-        buf, outs = carry
-        y = stage_fn(buf)
+        buf, outs, acc = carry
+        if with_stats:
+            y, stats = stage_fn(buf)
+            # device ``me`` chews a REAL microbatch at ticks
+            # me <= t < me + m; bubble ticks must not pollute the stats
+            valid = ((t >= me) & (t < me + m))
+            acc = jax.tree.map(
+                lambda a, st: a + jnp.where(valid, st, 0.0).astype(a.dtype),
+                acc, stats)
+        else:
+            y = stage_fn(buf)
         # last stage banks microbatch (t - (s-1)) once it's really done;
         # bubble writes clobber slot 0 early but the valid write lands later
         idx = jnp.clip(t - (s - 1), 0, m - 1)
@@ -83,12 +104,16 @@ def pipeline_apply(stage_fn: Callable[[jax.Array], jax.Array],
         shifted = lax.ppermute(y, axis_name, perm)
         nxt = jnp.clip(t + 1, 0, m - 1)
         buf = jnp.where(me == 0, microbatches[nxt], shifted)
-        return (buf, outs), None
+        return (buf, outs, acc), None
 
-    (_, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(m + s - 1))
+    (_, outs, acc), _ = lax.scan(tick, (buf0, outs0, stats0),
+                                 jnp.arange(m + s - 1))
     # broadcast the last stage's banked outputs to every stage
     mask = (me == s - 1).astype(outs.dtype)
-    return lax.psum(outs * mask, axis_name)
+    outs = lax.psum(outs * mask, axis_name)
+    if with_stats:
+        return outs, jax.tree.map(lambda a: a / m, acc)
+    return outs
 
 
 # ---------------------------------------------------------------------------

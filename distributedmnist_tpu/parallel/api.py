@@ -81,13 +81,10 @@ def state_partition_specs(model: Model, cfg: ExperimentConfig,
     if n_stage > 1 and getattr(model, "pp_param_specs", None) is None:
         raise ValueError(f"mesh has pipeline_parallelism={n_stage} but model "
                          f"{model.name!r} has no pipeline parameter specs")
-    if n_expert > 1 and n_stage > 1:
-        raise ValueError("expert parallelism does not yet compose with "
-                         "pipeline parallelism (aux loss cannot cross the "
-                         "stage pipeline)")
     if n_stage > 1:
         pspec: Any = model.pp_param_specs(
-            topo.stage_axis, topo.model_axis if n_model > 1 else None)
+            topo.stage_axis, topo.model_axis if n_model > 1 else None,
+            topo.expert_axis if n_expert > 1 else None)
     elif n_model > 1 or n_expert > 1:
         pspec = model.tp_param_specs(
             topo.model_axis if n_model > 1 else None,
@@ -222,10 +219,6 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
             f"{n_model} / expert_parallelism={n_expert} but model "
             f"{model.name!r} supports none of them "
             "(no sharded_apply_factory)")
-    if n_expert > 1 and n_stage > 1:
-        raise ValueError("expert parallelism does not yet compose with "
-                         "pipeline parallelism (aux loss cannot cross the "
-                         "stage pipeline)")
     pp_schedule = cfg.mesh.pipeline_schedule
     if pp_schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline_schedule {pp_schedule!r}")
@@ -241,10 +234,10 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
             # chunk would have to run on every device every tick
             # regardless of that device's scheduled work; the GPipe
             # path composes them, this schedule refuses them for now.
-            if n_model > 1 or n_seq > 1:
+            if n_model > 1 or n_seq > 1 or n_expert > 1:
                 raise ValueError(
                     "pipeline_schedule='1f1b' does not compose with "
-                    "tensor/sequence parallelism yet (use 'gpipe')")
+                    "tensor/sequence/expert parallelism yet (use 'gpipe')")
             if getattr(model, "pp_1f1b_grads_factory", None) is None:
                 raise ValueError(f"model {model.name!r} has no 1f1b "
                                  "pipeline support")
@@ -254,14 +247,15 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
             pp_apply = None
         else:
             # PP outermost; TP (model axis) inside each stage; SP (seq
-            # axis) through the stage blocks' sharded attention — every
-            # (stage, seq) device runs the same tick schedule so the
-            # attention collectives stay lockstep inside the pipeline
-            # scan
+            # axis) through the stage blocks' sharded attention; EP
+            # (expert axis) through the blocks' grouped MoE dispatch —
+            # every device runs the same tick schedule so attention and
+            # expert collectives stay lockstep inside the pipeline scan
             pp_apply = model.pp_apply_factory(
                 stage_ax, cfg.mesh.pipeline_microbatches,
                 model_ax if n_model > 1 else None,
-                seq_ax if n_seq > 1 else None)
+                seq_ax if n_seq > 1 else None,
+                expert_ax if n_expert > 1 else None)
     else:
         pp_apply = None
     sharded_apply = (model.sharded_apply_factory(
@@ -299,6 +293,9 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
 
     def local_loss_pp(params, batch, dropout_key):
         del dropout_key
+        if has_aux:  # MoE through the pipeline: batch-mean-stats aux
+            logits, aux = pp_apply(params, batch["image"], return_aux=True)
+            return model.loss(logits, batch["label"]) + aux_w * aux, logits
         logits = pp_apply(params, batch["image"])  # stage-replicated
         return model.loss(logits, batch["label"]), logits
 
@@ -549,17 +546,19 @@ def build_eval_step(model: Model, cfg: ExperimentConfig, topo: Topology):
             raise ValueError(f"mesh has pipeline_parallelism={n_stage} but "
                              f"model {model.name!r} has no pipeline apply")
         tp_ax = model_ax if n_model > 1 else None
-        pspec: Any = model.pp_param_specs(topo.stage_axis, tp_ax)
+        ep_ax = topo.expert_axis if n_expert > 1 else None
+        pspec: Any = model.pp_param_specs(topo.stage_axis, tp_ax, ep_ax)
         if cfg.mesh.pipeline_schedule == "1f1b":
-            if n_model > 1:  # same refusal the train path makes
+            if n_model > 1 or n_expert > 1:  # same refusals as training
                 raise ValueError(
                     "pipeline_schedule='1f1b' does not compose with "
-                    "tensor parallelism yet (use 'gpipe')")
+                    "tensor/expert parallelism yet (use 'gpipe')")
             # chunk-interleaved param layout → the chunked-ring apply
             eval_pp_apply = model.pp_1f1b_apply_factory(
                 topo.stage_axis, 1, cfg.mesh.pipeline_chunks)
         else:
-            eval_pp_apply = model.pp_apply_factory(topo.stage_axis, 1, tp_ax)
+            eval_pp_apply = model.pp_apply_factory(topo.stage_axis, 1, tp_ax,
+                                                   None, ep_ax)
 
         def run(params, images):
             return eval_pp_apply(params, images)

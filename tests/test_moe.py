@@ -220,13 +220,72 @@ def test_ep_step_matches_dense_update(n_replicas, n_expert, n_model, n_seq):
                                    rtol=3e-4, atol=3e-5)
 
 
-def test_moe_pp_combo_rejected():
-    """PP×EP stays refused: the aux loss cannot cross the stage
-    pipeline (parallel/api.py guard)."""
+@pytest.mark.parametrize("n_replicas,n_stage,n_expert,microbatches", [
+    (1, 2, 2, 2),   # PP×EP: experts sharded inside pipeline stages
+    (1, 2, 2, 4),   # more microbatches → smaller microbatch-local groups
+    (2, 2, 1, 2),   # DP×PP on the MoE model (all experts on every stage)
+])
+def test_pp_ep_step_matches_dense_update(n_replicas, n_stage, n_expert,
+                                         microbatches):
+    """MoE through the pipeline: per-tick grouped dispatch with
+    microbatch-local capacity, aux formed from routing stats
+    accumulated across the real ticks (bubbles excluded) — must equal
+    the dense single-device update exactly (capacity non-binding)."""
+    cfg = _cfg(n_replicas=n_replicas)
+    batch = _tokens(cfg)
+    want_loss, want_params = _dense_moe_update(cfg, batch)
+
+    topo = make_topology(MeshConfig(num_replicas=n_replicas,
+                                    pipeline_parallelism=n_stage,
+                                    pipeline_microbatches=microbatches,
+                                    expert_parallelism=n_expert))
+    model = get_model(cfg.model)
+    specs = state_partition_specs(model, cfg, topo)
+    state = topo.device_put_state(init_train_state(model, cfg, topo), specs)
+    step_fn = build_train_step(model, cfg, topo, constant(LR))
+    state, metrics = step_fn(state, topo.device_put_batch(batch))
+
+    np.testing.assert_allclose(float(metrics["loss"]), float(want_loss),
+                               rtol=2e-5, atol=2e-5)
+    got = jax.device_get(state.params)
+    want_stacked = transformer.stack_block_params(want_params)
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want_stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-5)
+
+
+def test_trainer_end_to_end_pp_ep(tmp_train_dir):
+    """Full Trainer on (replica=2, stage=2, expert=2): MoE pipeline
+    training with quorum on the replica axis, eval through the M=1
+    pipeline apply, resume with stacked expert-sharded params."""
+    from distributedmnist_tpu.train.loop import Trainer
+
+    cfg = _cfg(n_replicas=2).override({
+        "mesh.num_replicas": 2, "mesh.pipeline_parallelism": 2,
+        "mesh.pipeline_microbatches": 2, "mesh.expert_parallelism": 2,
+        "sync.mode": "quorum", "sync.num_replicas_to_aggregate": 1,
+        "sync.straggler_profile": "lognormal",
+        "train.max_steps": 8, "train.train_dir": tmp_train_dir,
+        "train.log_every_steps": 4, "train.save_interval_secs": 0,
+        "train.save_interval_steps": 4,
+    })
+    tr = Trainer(cfg)
+    assert tr.run()["final_step"] == 8
+    ev = tr.evaluate("test")
+    assert np.isfinite(ev["loss"])
+    tr2 = Trainer(cfg.override({"train.max_steps": 10}))
+    assert tr2._start_step == 8
+    assert tr2.run()["final_step"] == 10
+
+
+def test_moe_pp_sp_combo_rejected():
+    """PP×SP×EP stays refused (the SP partial-loss path does not
+    thread the aux loss)."""
     cfg = _cfg()
     topo = make_topology(MeshConfig(num_replicas=1, expert_parallelism=2,
+                                    seq_parallelism=2,
                                     pipeline_parallelism=2))
-    with pytest.raises(ValueError, match="pipeline"):
+    with pytest.raises(ValueError, match="aux"):
         build_train_step(get_model(cfg.model), cfg, topo, constant(LR))
 
 
