@@ -220,13 +220,14 @@ def test_ep_step_matches_dense_update(n_replicas, n_expert, n_model, n_seq):
                                    rtol=3e-4, atol=3e-5)
 
 
-@pytest.mark.parametrize("n_replicas,n_stage,n_expert,microbatches", [
-    (1, 2, 2, 2),   # PP×EP: experts sharded inside pipeline stages
-    (1, 2, 2, 4),   # more microbatches → smaller microbatch-local groups
-    (2, 2, 1, 2),   # DP×PP on the MoE model (all experts on every stage)
+@pytest.mark.parametrize("n_replicas,n_stage,n_expert,n_model,microbatches", [
+    (1, 2, 2, 1, 2),   # PP×EP: experts sharded inside pipeline stages
+    (1, 2, 2, 1, 4),   # more microbatches → smaller microbatch-local groups
+    (2, 2, 1, 1, 2),   # DP×PP on the MoE model (all experts on every stage)
+    (1, 2, 2, 2, 2),   # PP×EP×TP: layer × expert × hidden-slice sharding
 ])
 def test_pp_ep_step_matches_dense_update(n_replicas, n_stage, n_expert,
-                                         microbatches):
+                                         n_model, microbatches):
     """MoE through the pipeline: per-tick grouped dispatch with
     microbatch-local capacity, aux formed from routing stats
     accumulated across the real ticks (bubbles excluded) — must equal
@@ -238,7 +239,8 @@ def test_pp_ep_step_matches_dense_update(n_replicas, n_stage, n_expert,
     topo = make_topology(MeshConfig(num_replicas=n_replicas,
                                     pipeline_parallelism=n_stage,
                                     pipeline_microbatches=microbatches,
-                                    expert_parallelism=n_expert))
+                                    expert_parallelism=n_expert,
+                                    model_parallelism=n_model))
     model = get_model(cfg.model)
     specs = state_partition_specs(model, cfg, topo)
     state = topo.device_put_state(init_train_state(model, cfg, topo), specs)
