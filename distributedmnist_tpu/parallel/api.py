@@ -540,28 +540,44 @@ def build_eval_step(model: Model, cfg: ExperimentConfig, topo: Topology):
     n_stage = topo.mesh.shape[topo.stage_axis]
     n_expert = topo.mesh.shape[topo.expert_axis]
     if n_stage > 1:
-        # pipeline-parallel params: stacked layout, microbatch M=1
-        # (latency is irrelevant for eval; correctness is identical)
+        # pipeline-parallel params: stacked layout. Eval pipelines at
+        # the largest microbatch count that divides the per-replica
+        # eval rows (capped by the training cadence) — M=1 would run
+        # the stages fully serialized, an S× eval slowdown measured in
+        # the tens of minutes on deep CPU-mesh evals.
         if getattr(model, "pp_apply_factory", None) is None:
             raise ValueError(f"mesh has pipeline_parallelism={n_stage} but "
                              f"model {model.name!r} has no pipeline apply")
         tp_ax = model_ax if n_model > 1 else None
         ep_ax = topo.expert_axis if n_expert > 1 else None
         pspec: Any = model.pp_param_specs(topo.stage_axis, tp_ax, ep_ax)
-        if cfg.mesh.pipeline_schedule == "1f1b":
-            if n_model > 1 or n_expert > 1:  # same refusals as training
-                raise ValueError(
-                    "pipeline_schedule='1f1b' does not compose with "
-                    "tensor/expert parallelism yet (use 'gpipe')")
-            # chunk-interleaved param layout → the chunked-ring apply
-            eval_pp_apply = model.pp_1f1b_apply_factory(
-                topo.stage_axis, 1, cfg.mesh.pipeline_chunks)
-        else:
-            eval_pp_apply = model.pp_apply_factory(topo.stage_axis, 1, tp_ax,
-                                                   None, ep_ax)
+        if (cfg.mesh.pipeline_schedule == "1f1b"
+                and (n_model > 1 or n_expert > 1)):  # same as training
+            raise ValueError(
+                "pipeline_schedule='1f1b' does not compose with "
+                "tensor/expert parallelism yet (use 'gpipe')")
+        cap = max(1, cfg.mesh.pipeline_microbatches)
 
         def run(params, images):
-            return eval_pp_apply(params, images)
+            # per-replica rows are static at trace time (eval batches
+            # are padded to a fixed shape); pipeline at the largest
+            # microbatch count ≤ the training cadence that divides
+            # them. EXCEPT MoE: expert capacity is token-group-local,
+            # so the microbatch split would change which tokens group
+            # together and eval metrics would vary with the divisor of
+            # the batch size — MoE evaluates at M=1 (one full-batch
+            # grouping, the dense oracle's own), trading the pipeline
+            # overlap for metric stability.
+            b = images.shape[0]
+            m_eval = (1 if getattr(model, "has_aux", False) else
+                      max(m for m in range(1, cap + 1) if b % m == 0))
+            if cfg.mesh.pipeline_schedule == "1f1b":
+                apply_fn = model.pp_1f1b_apply_factory(
+                    topo.stage_axis, m_eval, cfg.mesh.pipeline_chunks)
+            else:
+                apply_fn = model.pp_apply_factory(topo.stage_axis, m_eval,
+                                                  tp_ax, None, ep_ax)
+            return apply_fn(params, images)
     elif n_model > 1 or n_expert > 1:
         # tensor-/expert-parallel params: sharded apply (full sequence
         # per device — eval batches are not seq-sharded), sharded in_spec
