@@ -260,14 +260,15 @@ def _transformer(cfg: ModelConfig) -> Model:
                          model_axis: str | None = None,
                          seq_axis: str | None = None,
                          expert_axis: str | None = None):
-        if moe and seq_axis is not None:
-            raise ValueError(
-                "PP×SP with mixture-of-experts is not supported (the SP "
-                "partial-loss path does not thread the aux loss)")
         if expert_axis is not None and not moe:
             raise ValueError("mesh has expert parallelism but the model has "
                              "no experts (model.num_experts == 0)")
         pp_attn = make_seq_attn(seq_axis)
+        # PP×SP×MoE: each tick's MoE calls see one microbatch's SLICE
+        # of one seq shard; averaging the routing stats over the seq
+        # axis (plus the tick accumulation) reconstructs the exact
+        # full-token aux (see sharded_apply_factory's SP×MoE note)
+        stats_axes = (seq_axis,) if (moe and seq_axis is not None) else ()
 
         def apply_pp(params, tokens, positions=None, return_aux=False):
             return transformer.apply_pp(
@@ -277,6 +278,7 @@ def _transformer(cfg: ModelConfig) -> Model:
                 model_axis=model_axis, expert_axis=expert_axis,
                 num_experts=cfg.num_experts,
                 capacity_factor=cfg.expert_capacity_factor,
+                moe_stats_axes=stats_axes,
                 compute_dtype=compute_dtype, remat=cfg.remat,
                 return_aux=return_aux)
         return apply_pp

@@ -280,6 +280,7 @@ def apply_pp(params: Params, tokens: jax.Array, *, num_heads: int,
              model_axis: str | None = None,
              expert_axis: str | None = None, num_experts: int = 0,
              capacity_factor: float = 1.25,
+             moe_stats_axes: tuple[str, ...] = (),
              compute_dtype=jnp.bfloat16, remat: bool = False,
              return_aux: bool = False) -> jax.Array:
     """Pipeline-parallel forward (inside shard_map, params in the
@@ -312,6 +313,9 @@ def apply_pp(params: Params, tokens: jax.Array, *, num_heads: int,
     accumulated across the real microbatch ticks (pipeline_apply
     ``with_stats``) and the aux is formed from the batch-mean stats —
     exactly the dense full-batch value. ``return_aux`` returns it.
+    ``moe_stats_axes``: extra token-sharding axes (the seq axis under
+    PP×SP×EP) the per-call routing statistics additionally average
+    over, keeping that exactness when each call sees a token slice.
     """
     from ..ops.pipeline import pipeline_apply
 
@@ -342,6 +346,7 @@ def apply_pp(params: Params, tokens: jax.Array, *, num_heads: int,
                                    expert_axis=expert_axis,
                                    num_experts=num_experts,
                                    capacity_factor=capacity_factor,
+                                   moe_stats_axes=moe_stats_axes,
                                    moe_return_stats=moe)
             return out, (st if moe else None)
 

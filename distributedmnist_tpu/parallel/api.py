@@ -318,7 +318,7 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
             b, s_loc = tokens.shape
             me_s = lax.axis_index(seq_ax)
             positions = me_s * s_loc + jnp.arange(s_loc)
-            if with_aux:  # MoE: EP-only (seq axis size 1) or SP×EP
+            if with_aux:  # MoE: EP-only, SP×EP, or PP×SP×EP
                 logits, aux = apply_fn(params, tokens, positions,
                                        return_aux=True)
             else:
@@ -346,7 +346,7 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
 
     local_loss_sp = (make_sp_loss(sharded_apply, has_aux)
                      if sharded_apply is not None else
-                     make_sp_loss(pp_apply, False)
+                     make_sp_loss(pp_apply, has_aux)
                      if (pp_apply is not None and n_seq > 1) else None)
 
     def shard_fn(state: TrainState, batch: dict,

@@ -280,15 +280,56 @@ def test_trainer_end_to_end_pp_ep(tmp_train_dir):
     assert tr2.run()["final_step"] == 10
 
 
-def test_moe_pp_sp_combo_rejected():
-    """PP×SP×EP stays refused (the SP partial-loss path does not
-    thread the aux loss)."""
-    cfg = _cfg()
-    topo = make_topology(MeshConfig(num_replicas=1, expert_parallelism=2,
-                                    seq_parallelism=2,
-                                    pipeline_parallelism=2))
-    with pytest.raises(ValueError, match="aux"):
-        build_train_step(get_model(cfg.model), cfg, topo, constant(LR))
+def test_trainer_end_to_end_pp_sp_ep(tmp_train_dir):
+    """Full Trainer at (stage=2, seq=2, expert=2): seq-sharded batches
+    through the MoE pipeline, eval, and checkpoint/resume."""
+    from distributedmnist_tpu.train.loop import Trainer
+
+    cfg = _cfg(n_replicas=1).override({
+        "mesh.num_replicas": 1, "mesh.pipeline_parallelism": 2,
+        "mesh.pipeline_microbatches": 2, "mesh.seq_parallelism": 2,
+        "mesh.expert_parallelism": 2,
+        "train.max_steps": 6, "train.train_dir": tmp_train_dir,
+        "train.log_every_steps": 3, "train.save_interval_secs": 0,
+        "train.save_interval_steps": 3,
+    })
+    tr = Trainer(cfg)
+    assert tr.run()["final_step"] == 6
+    ev = tr.evaluate("test")
+    assert np.isfinite(ev["loss"])
+    tr2 = Trainer(cfg.override({"train.max_steps": 8}))
+    assert tr2._start_step == 6
+    assert tr2.run()["final_step"] == 8
+
+
+def test_pp_sp_ep_step_matches_dense_update():
+    """The full stack at once — PP (layer stages) × SP (seq-sharded
+    tokens, ring attention lockstep in the pipeline scan) × EP (grouped
+    expert dispatch): per-tick routing stats pmean over (expert, seq)
+    and accumulate over real ticks, the SP partial loss pre-divides the
+    replicated aux — everything must still reproduce the dense
+    single-device update exactly."""
+    cfg = _cfg(n_replicas=1)
+    batch = _tokens(cfg)
+    want_loss, want_params = _dense_moe_update(cfg, batch)
+
+    topo = make_topology(MeshConfig(num_replicas=1, pipeline_parallelism=2,
+                                    pipeline_microbatches=2,
+                                    seq_parallelism=2, expert_parallelism=2))
+    model = get_model(cfg.model)
+    specs = state_partition_specs(model, cfg, topo)
+    state = topo.device_put_state(init_train_state(model, cfg, topo), specs)
+    step_fn = build_train_step(model, cfg, topo, constant(LR))
+    state, metrics = step_fn(state, topo.device_put_batch(batch,
+                                                          seq_sharded=True))
+
+    np.testing.assert_allclose(float(metrics["loss"]), float(want_loss),
+                               rtol=2e-5, atol=2e-5)
+    got = jax.device_get(state.params)
+    want_stacked = transformer.stack_block_params(want_params)
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want_stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-5)
 
 
 def test_ep_on_dense_model_rejected():
