@@ -70,6 +70,12 @@ def initialize_distributed() -> None:
         jax.distributed.initialize()
 
 
+# Env values as they were BEFORE the first simulate_devices call (None
+# = the variable was unset). strip_forced_platform_env restores exactly
+# this snapshot, so operator-set values survive untouched.
+_env_before_force: dict | None = None
+
+
 def simulate_devices(n: int) -> None:
     """Force an ``n``-virtual-CPU-device platform. MUST run before the
     XLA backend initializes — call from conftest/env setup.
@@ -84,6 +90,12 @@ def simulate_devices(n: int) -> None:
     the platform is forced via jax.config, not just env.
     """
     import re
+    global _env_before_force
+    if _env_before_force is None:
+        _env_before_force = {
+            "XLA_FLAGS": os.environ.get("XLA_FLAGS"),
+            "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS"),
+        }
     flags = os.environ.get("XLA_FLAGS", "")
     flag = f"--xla_force_host_platform_device_count={n}"
     if "xla_force_host_platform_device_count" in flags:
@@ -107,17 +119,19 @@ def simulate_devices(n: int) -> None:
 def strip_forced_platform_env(env: dict) -> dict:
     """Undo :func:`simulate_devices`' env mutations in a CHILD's env so
     a subprocess boots the true ambient backend (the campaign's lean
-    single-device evaluator). Kept here, next to the code that writes
-    the flag, so the two can't drift."""
-    import re
+    single-device evaluator). Restores the exact pre-force snapshot —
+    values the operator set themselves (e.g. a deliberate
+    JAX_PLATFORMS=cpu pin) are preserved, and if simulate_devices never
+    ran in this process the env passes through unchanged. Kept here,
+    next to the code that writes the flag, so the two can't drift."""
     env = dict(env)
-    env.pop("JAX_PLATFORMS", None)
-    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                   env.get("XLA_FLAGS", "")).strip()
-    if flags:
-        env["XLA_FLAGS"] = flags
-    else:
-        env.pop("XLA_FLAGS", None)
+    if _env_before_force is None:
+        return env  # nothing was forced in this process
+    for key, orig in _env_before_force.items():
+        if orig is None:
+            env.pop(key, None)
+        else:
+            env[key] = orig
     return env
 
 

@@ -225,6 +225,25 @@ def prune_heavy_artifacts(results_dir: Path) -> None:
         p.unlink()
 
 
+# Self-description for the summary JSON: groups whose accuracy columns
+# are step-budget-bounded by design carry a pointer to the long-run
+# convergence proof, so the summary cannot be misread on its own. Each
+# note is keyed (group, proof-run name) and only emitted when the cited
+# proof run actually exists in the same results dir.
+SUMMARY_NOTES = {
+    ("interval", "interval_long"): (
+        "accuracies are NOT converged by design: the fixed 300-step "
+        "budget yields only 39-84 applied updates, enough to rank the "
+        "pacings. Convergence proof: long/interval_long (same 3000 ms "
+        "pacing, 681 applied updates, test_accuracy 1.0)."),
+    ("cdf50", "cdf50_long"): (
+        "accuracies are a 100-step-budget artifact: this grid measures "
+        "barrier timing, not convergence. Convergence proof: "
+        "long/cdf50_long (full-barrier at n=50, 400 updates, "
+        "test_accuracy 1.0)."),
+}
+
+
 def finalize(results_dir: Path) -> None:
     """Regenerate every group's report.md/figures from its
     sweep_results.jsonl with the CURRENT analysis code, rebuild the
@@ -246,8 +265,11 @@ def finalize(results_dir: Path) -> None:
                                ("name", "test_accuracy", "examples_per_sec",
                                 "updates_applied")} for r in records]
         logger.info("finalized %s (%d experiments)", gdir.name, len(records))
+    long_names = {r.get("name") for r in summary.get("long", ())}
+    notes = {g: note for (g, proof), note in SUMMARY_NOTES.items()
+             if g in summary and proof in long_names}
     (results_dir / "campaign_summary.json").write_text(
-        json.dumps({"groups": summary}, indent=2))
+        json.dumps({"groups": summary, "notes": notes}, indent=2))
     prune_heavy_artifacts(results_dir)
 
 

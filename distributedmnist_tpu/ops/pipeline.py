@@ -21,7 +21,7 @@ backward pass, giving the classic backward pipeline for free.
 from __future__ import annotations
 
 import functools
-from typing import Callable
+from typing import Callable, Mapping
 
 import jax
 import jax.numpy as jnp
@@ -143,7 +143,7 @@ def pipeline_apply(stage_fn: Callable, microbatches: jax.Array,
 @functools.lru_cache(maxsize=None)
 def make_1f1b_schedule(num_stages: int, num_chunks: int,
                        num_microbatches: int,
-                       forward_only: bool = False) -> dict:
+                       forward_only: bool = False) -> "Mapping":
     """Build the static interleaved-1F1B tables (greedy list scheduler,
     backward-priority — the 1F1B rule — with forwards preferring the
     deepest ready chunk to keep chains moving).
@@ -245,7 +245,13 @@ def make_1f1b_schedule(num_stages: int, num_chunks: int,
     assert forward_only or len(b_done) == M * C
     tables["ticks"] = T
     tables["idle_slots"] = S * T - (1 if forward_only else 2) * M * C
-    return tables
+    # the lru_cache hands the SAME object to every caller: freeze it so
+    # a mutating caller cannot silently poison later schedule lookups
+    import types
+    for a in tables.values():
+        if isinstance(a, np.ndarray):
+            a.flags.writeable = False
+    return types.MappingProxyType(tables)
 
 
 def _index_pytree(tree, idx):

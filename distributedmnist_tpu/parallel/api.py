@@ -556,6 +556,12 @@ def build_eval_step(model: Model, cfg: ExperimentConfig, topo: Topology):
             raise ValueError(
                 "pipeline_schedule='1f1b' does not compose with "
                 "tensor/expert parallelism yet (use 'gpipe')")
+        if (cfg.mesh.pipeline_schedule == "1f1b"
+                and getattr(model, "pp_1f1b_apply_factory", None) is None):
+            # mirror the train-path guard: fail with a clear error at
+            # build time instead of an opaque trace-time NoneType call
+            raise ValueError(f"model {model.name!r} has no 1f1b "
+                             "pipeline support")
         cap = max(1, cfg.mesh.pipeline_microbatches)
 
         def run(params, images):
