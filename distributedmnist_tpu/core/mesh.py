@@ -122,16 +122,26 @@ def strip_forced_platform_env(env: dict) -> dict:
     single-device evaluator). Restores the exact pre-force snapshot —
     values the operator set themselves (e.g. a deliberate
     JAX_PLATFORMS=cpu pin) are preserved, and if simulate_devices never
-    ran in this process the env passes through unchanged. Kept here,
-    next to the code that writes the flag, so the two can't drift."""
+    ran in this process the env passes through unchanged. The one
+    exception: a ``--xla_force_host_platform_device_count`` flag is
+    stripped even if it predates the force — an evaluator child on a
+    forced multi-device mesh would recreate exactly the trainer
+    contention this function exists to avoid. Kept here, next to the
+    code that writes the flag, so the two can't drift."""
+    import re
     env = dict(env)
-    if _env_before_force is None:
-        return env  # nothing was forced in this process
-    for key, orig in _env_before_force.items():
-        if orig is None:
-            env.pop(key, None)
-        else:
-            env[key] = orig
+    if _env_before_force is not None:
+        for key, orig in _env_before_force.items():
+            if orig is None:
+                env.pop(key, None)
+            else:
+                env[key] = orig
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", "")).strip()
+    if flags:
+        env["XLA_FLAGS"] = flags
+    else:
+        env.pop("XLA_FLAGS", None)
     return env
 
 

@@ -88,10 +88,13 @@ class Model:
                        | None) = None
     # Interleaved-1F1B schedule support (mesh.pipeline_schedule="1f1b"):
     # pp_transform_chunked(params, S, v) restacks into the
-    # chunk-interleaved layout; pp_1f1b_grads_factory(stage_axis, M, v)
-    # -> grads_fn(params, tokens, labels) -> (loss, acc, grads) (the
-    # fused forward/backward engine — no outer value_and_grad);
-    # pp_1f1b_apply_factory(stage_axis, M, v) -> apply for eval.
+    # chunk-interleaved layout; pp_1f1b_grads_factory(stage_axis, M, v,
+    # model_axis=None, seq_axis=None, expert_axis=None) ->
+    # grads_fn(params, tokens, labels) -> (loss, acc, grads) (the
+    # fused forward/backward engine — no outer value_and_grad; under
+    # seq_axis the outputs are per-shard partials the caller psums);
+    # pp_1f1b_apply_factory(stage_axis, M, v, model_axis=None) ->
+    # apply for eval.
     pp_transform_chunked: Callable[..., Any] | None = None
     pp_1f1b_grads_factory: Callable[..., Callable[..., tuple]] | None = None
     pp_1f1b_apply_factory: (Callable[..., Callable[..., jax.Array]]
@@ -284,29 +287,46 @@ def _transformer(cfg: ModelConfig) -> Model:
         return apply_pp
 
     def pp_1f1b_grads_factory(stage_axis: str, num_microbatches: int,
-                              num_chunks: int):
+                              num_chunks: int,
+                              model_axis: str | None = None,
+                              seq_axis: str | None = None,
+                              expert_axis: str | None = None):
+        if expert_axis is not None and not moe:
+            raise ValueError("mesh has expert parallelism but the model has "
+                             "no experts (model.num_experts == 0)")
         if moe:
             raise ValueError(
                 "mixture-of-experts does not compose with the 1f1b "
                 "pipeline schedule yet (the fused engine does not "
                 "accumulate routing statistics); use "
                 "mesh.pipeline_schedule='gpipe', which supports MoE")
+        if seq_axis is not None and cfg.sp_attention == "ring":
+            raise ValueError(
+                "pipeline_schedule='1f1b' with sequence parallelism "
+                "requires model.sp_attention='ulysses': ring attention's "
+                "ppermute rendezvouses globally and deadlocks inside the "
+                "fused engine's stage-varying branches (all_to_all is "
+                "group-local and composes; use 'gpipe' for ring)")
+        pp_attn = make_seq_attn(seq_axis)
 
         def grads_fn(params, tokens, labels):
             return transformer.grads_pp_1f1b(
                 params, tokens, labels, num_heads=cfg.num_heads,
                 stage_axis=stage_axis, num_microbatches=num_microbatches,
-                num_chunks=num_chunks, attention_fn=attention_fn,
+                num_chunks=num_chunks, attention_fn=pp_attn,
+                model_axis=model_axis, seq_axis=seq_axis,
                 compute_dtype=compute_dtype)
         return grads_fn
 
     def pp_1f1b_apply_factory(stage_axis: str, num_microbatches: int,
-                              num_chunks: int):
+                              num_chunks: int,
+                              model_axis: str | None = None):
         def apply_1f1b(params, tokens):
             return transformer.apply_pp_1f1b(
                 params, tokens, num_heads=cfg.num_heads,
                 stage_axis=stage_axis, num_microbatches=num_microbatches,
                 num_chunks=num_chunks, attention_fn=attention_fn,
+                model_axis=model_axis,
                 compute_dtype=compute_dtype)
         return apply_1f1b
 

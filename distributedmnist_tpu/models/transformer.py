@@ -405,6 +405,8 @@ def stack_block_params_chunked(params: Params, num_stages: int,
 def grads_pp_1f1b(params: Params, tokens: jax.Array, labels: jax.Array, *,
                   num_heads: int, stage_axis: str, num_microbatches: int,
                   num_chunks: int, attention_fn: Callable | None = None,
+                  model_axis: str | None = None,
+                  seq_axis: str | None = None,
                   compute_dtype=jnp.bfloat16):
     """Fused interleaved-1F1B training step body (inside shard_map,
     params in the chunk-interleaved stacked layout of
@@ -420,25 +422,52 @@ def grads_pp_1f1b(params: Params, tokens: jax.Array, labels: jax.Array, *,
     contribution. Returns (loss, train_acc, grads) with ``grads``
     matching the parameter layout.
 
-    TP/SP do not yet compose with this schedule (the GPipe path does);
-    the registry refuses those meshes up front.
+    ``model_axis`` composes Megatron TP inside every chunk and
+    ``seq_axis`` composes SP (a seq-sharded ``attention_fn`` +
+    cross-shard partial loss). Chunk-internal collectives execute
+    INSIDE the engine's device-varying ``lax.switch`` branches; that is
+    safe exactly when the collective's runtime rendezvous is
+    GROUP-LOCAL and its participant group shares one stage coordinate
+    (so every participant takes the same branch each tick): psum /
+    all_to_all over the model, seq, or expert axes qualify. It is NOT
+    safe for ``lax.ppermute`` — XLA lowers collective-permute with a
+    GLOBAL participant list, so devices on other stages (in other
+    branches) would be waited on forever (measured deadlock on the CPU
+    backend's rendezvous). Hence SP under this schedule requires the
+    all-to-all (Ulysses) attention — the registry refuses ring — and
+    the cross-shard target shift runs OUTSIDE the engine, below.
+    Stage-axis collectives stay forbidden in branches entirely (the
+    engine's lockstep ppermutes handle stage transfer).
+
+    Under SP the returned loss/accuracy/grads are this seq shard's
+    PARTIALS (normalized so a psum over the seq axis reassembles the
+    exact dense values — same contract as the GPipe PP×SP path); the
+    caller performs that psum.
     """
     from ..ops.pipeline import pipeline_1f1b_grads
 
     attn = attention_fn or local_self_attention
-    b, s = tokens.shape
+    b, s_loc = tokens.shape
     if b % num_microbatches != 0:
         raise ValueError(f"batch {b} not divisible by "
                          f"num_microbatches={num_microbatches}")
+    m_tp = lax.axis_size(model_axis) if model_axis else 1
+    if num_heads % m_tp != 0:
+        raise ValueError(f"num_heads={num_heads} not divisible by "
+                         f"model-parallel size {m_tp}")
+    n_seq = lax.axis_size(seq_axis) if seq_axis else 1
     p = jax.tree.map(lambda a: a.astype(compute_dtype), params)
     d = p["embed"].shape[-1]
     hd = d // num_heads
-    positions = jnp.arange(s)
+    if seq_axis is not None:
+        positions = lax.axis_index(seq_axis) * s_loc + jnp.arange(s_loc)
+    else:
+        positions = jnp.arange(s_loc)
     mb = b // num_microbatches
     M = num_microbatches
 
     def emb_fn(embed, pos):
-        return (embed[tokens] + pos[positions]).reshape(M, mb, s, d)
+        return (embed[tokens] + pos[positions]).reshape(M, mb, s_loc, d)
 
     micro, emb_vjp = jax.vjp(emb_fn, p["embed"], p["pos"])
 
@@ -449,20 +478,45 @@ def grads_pp_1f1b(params: Params, tokens: jax.Array, labels: jax.Array, *,
 
     def chunk_fn(slot_params, act):
         def layer(carry, blk):
-            out, _aux = _apply_block(carry, blk, h_local=num_heads, hd=hd,
-                                     attn=attn, model_axis=None)
+            out, _aux = _apply_block(carry, blk, h_local=num_heads // m_tp,
+                                     hd=hd, attn=attn, model_axis=model_axis)
             return out, None
         out, _ = lax.scan(layer, act, slot_params)
         return out
 
-    labels_mb = labels.reshape(M, mb, s)
+    labels_mb = labels.reshape(M, mb, s_loc)
     head_params = {"embed": p["embed"], "final_norm": p["final_norm"]}
 
-    def head_fn(hp, y, m):
-        x = _rms_norm(y, hp["final_norm"])
-        logits = (x @ hp["embed"].T).astype(jnp.float32)
-        lab = lax.dynamic_index_in_dim(labels_mb, m, 0, keepdims=False)
-        return loss_fn(logits, lab), accuracy(logits, lab)
+    if seq_axis is None:
+        def head_fn(hp, y, m):
+            x = _rms_norm(y, hp["final_norm"])
+            logits = (x @ hp["embed"].T).astype(jnp.float32)
+            lab = lax.dynamic_index_in_dim(labels_mb, m, 0, keepdims=False)
+            return loss_fn(logits, lab), accuracy(logits, lab)
+    else:
+        # the SP partial loss (same math as parallel.api.make_sp_loss):
+        # shard j's last-token target lives on shard j+1. The fetching
+        # ppermute must run OUT HERE, unconditionally on every device —
+        # collective-permute rendezvouses globally and would deadlock
+        # inside the engine's stage-varying branches (docstring above).
+        s_global = s_loc * n_seq
+        seq_perm = [((j + 1) % n_seq, j) for j in range(n_seq)]
+        nxt = lax.ppermute(labels[:, :1], seq_axis, seq_perm)
+        tgt_mb = jnp.concatenate([labels[:, 1:], nxt],
+                                 axis=1).astype(jnp.int32).reshape(M, mb,
+                                                                   s_loc)
+
+        def head_fn(hp, y, m):
+            x = _rms_norm(y, hp["final_norm"])
+            logits = (x @ hp["embed"].T).astype(jnp.float32)
+            tgt = lax.dynamic_index_in_dim(tgt_mb, m, 0, keepdims=False)
+            w = (positions < s_global - 1).astype(jnp.float32)[None, :]
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+            correct = (jnp.argmax(logp, axis=-1) == tgt).astype(jnp.float32)
+            total = mb * (s_global - 1)  # this microbatch's global count
+            return (jnp.sum(nll * w) / total,
+                    jnp.sum(correct * w) / total)
 
     losses, accs, dinputs, dchunk, dhead = pipeline_1f1b_grads(
         chunk_fn, head_fn, chunk_params, head_params, micro,
@@ -491,11 +545,15 @@ def grads_pp_1f1b(params: Params, tokens: jax.Array, labels: jax.Array, *,
 def apply_pp_1f1b(params: Params, tokens: jax.Array, *, num_heads: int,
                   stage_axis: str, num_microbatches: int, num_chunks: int,
                   attention_fn: Callable | None = None,
+                  model_axis: str | None = None,
                   compute_dtype=jnp.bfloat16) -> jax.Array:
     """Forward-only apply for the chunk-interleaved layout (eval under
     schedule="1f1b"): the chunked ring (ops/pipeline.py:
     pipeline_chunked_forward) with embedding/head outside, same
-    contract as :func:`apply_pp`."""
+    contract as :func:`apply_pp`. ``model_axis`` composes Megatron TP
+    inside each chunk — the forward ring computes every chunk
+    unconditionally (``jnp.where`` select, not a branch), so the TP
+    psums run lockstep on every device every tick."""
     from ..ops.pipeline import pipeline_chunked_forward
 
     attn = attention_fn or local_self_attention
@@ -503,6 +561,10 @@ def apply_pp_1f1b(params: Params, tokens: jax.Array, *, num_heads: int,
     if b % num_microbatches != 0:
         raise ValueError(f"batch {b} not divisible by "
                          f"num_microbatches={num_microbatches}")
+    m_tp = lax.axis_size(model_axis) if model_axis else 1
+    if num_heads % m_tp != 0:
+        raise ValueError(f"num_heads={num_heads} not divisible by "
+                         f"model-parallel size {m_tp}")
     p = jax.tree.map(lambda a: a.astype(compute_dtype), params)
     d = p["embed"].shape[-1]
     hd = d // num_heads
@@ -520,8 +582,8 @@ def apply_pp_1f1b(params: Params, tokens: jax.Array, *, num_heads: int,
         slot_params = _index_pytree(chunk_params, slot)
 
         def layer(carry, blk):
-            out, _aux = _apply_block(carry, blk, h_local=num_heads, hd=hd,
-                                     attn=attn, model_axis=None)
+            out, _aux = _apply_block(carry, blk, h_local=num_heads // m_tp,
+                                     hd=hd, attn=attn, model_axis=model_axis)
             return out, None
         out, _ = lax.scan(layer, act, slot_params)
         return out

@@ -230,20 +230,24 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         if pp_schedule == "1f1b":
             # fused interleaved schedule (ops/pipeline.py): explicit
             # forward/backward chunk-works in one scan — built below
-            # instead of value_and_grad. TP/SP collectives inside a
-            # chunk would have to run on every device every tick
-            # regardless of that device's scheduled work; the GPipe
-            # path composes them, this schedule refuses them for now.
-            if n_model > 1 or n_seq > 1 or n_expert > 1:
+            # instead of value_and_grad. TP/SP collectives inside the
+            # chunk bodies execute inside the engine's stage-varying
+            # switch branches; that is safe because they reduce over
+            # NON-stage axes whose participant groups share a stage
+            # coordinate and hence a branch (ops/pipeline.py notes).
+            if n_expert > 1:
                 raise ValueError(
                     "pipeline_schedule='1f1b' does not compose with "
-                    "tensor/sequence/expert parallelism yet (use 'gpipe')")
+                    "expert parallelism yet (use 'gpipe')")
             if getattr(model, "pp_1f1b_grads_factory", None) is None:
                 raise ValueError(f"model {model.name!r} has no 1f1b "
                                  "pipeline support")
             pp_1f1b_grads_fn = model.pp_1f1b_grads_factory(
                 stage_ax, cfg.mesh.pipeline_microbatches,
-                cfg.mesh.pipeline_chunks)
+                cfg.mesh.pipeline_chunks,
+                model_ax if n_model > 1 else None,
+                seq_ax if n_seq > 1 else None,
+                expert_ax if n_expert > 1 else None)
             pp_apply = None
         else:
             # PP outermost; TP (model axis) inside each stage; SP (seq
@@ -261,7 +265,8 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
     sharded_apply = (model.sharded_apply_factory(
         seq_ax if n_seq > 1 else None, model_ax if n_model > 1 else None,
         expert_ax if n_expert > 1 else None)
-        if (n_seq > 1 or n_model > 1 or n_expert > 1) and pp_apply is None
+        if ((n_seq > 1 or n_model > 1 or n_expert > 1)
+            and pp_apply is None and pp_1f1b_grads_fn is None)
         else None)
     # The SP/PP loss paths do not thread a dropout key; refuse loudly
     # instead of silently training a dropout model without dropout.
@@ -368,18 +373,25 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         dkey = prng.replica_key(state.root_key, "dropout", step, me)
         local_params = jax.tree.map(
             lambda x: lax.pcast(x, grad_axes, to="varying"), state.params)
-        if local_loss_sp is not None:  # DP×SP×TP, or PP×SP
+        if pp_1f1b_grads_fn is not None:
+            # fused 1F1B: the engine computes loss, accuracy and grads
+            # in one interleaved scan — no outer value_and_grad. Under
+            # SP the engine returns per-seq-shard partials; psum
+            # reassembles the exact dense values (same as the SP
+            # branch below).
+            loss, train_acc, grads = pp_1f1b_grads_fn(
+                local_params, batch["image"], batch["label"])
+            if n_seq > 1:
+                loss = lax.psum(loss, seq_ax)
+                train_acc = lax.psum(train_acc, seq_ax)
+                grads = jax.tree.map(lambda g: lax.psum(g, seq_ax), grads)
+        elif local_loss_sp is not None:  # DP×SP×TP, or PP×SP
             (loss_p, acc_p), grads = jax.value_and_grad(
                 local_loss_sp, has_aux=True)(local_params, batch, dkey)
             # reassemble the full-sequence gradient / metrics
             loss = lax.psum(loss_p, seq_ax)
             train_acc = lax.psum(acc_p, seq_ax)
             grads = jax.tree.map(lambda g: lax.psum(g, seq_ax), grads)
-        elif pp_1f1b_grads_fn is not None:
-            # fused 1F1B: the engine computes loss, accuracy and grads
-            # in one interleaved scan — no outer value_and_grad
-            loss, train_acc, grads = pp_1f1b_grads_fn(
-                local_params, batch["image"], batch["label"])
         elif pp_apply is not None:
             (loss, logits), grads = jax.value_and_grad(
                 local_loss_pp, has_aux=True)(local_params, batch, dkey)
@@ -552,10 +564,10 @@ def build_eval_step(model: Model, cfg: ExperimentConfig, topo: Topology):
         ep_ax = topo.expert_axis if n_expert > 1 else None
         pspec: Any = model.pp_param_specs(topo.stage_axis, tp_ax, ep_ax)
         if (cfg.mesh.pipeline_schedule == "1f1b"
-                and (n_model > 1 or n_expert > 1)):  # same as training
+                and n_expert > 1):  # same as training
             raise ValueError(
                 "pipeline_schedule='1f1b' does not compose with "
-                "tensor/expert parallelism yet (use 'gpipe')")
+                "expert parallelism yet (use 'gpipe')")
         if (cfg.mesh.pipeline_schedule == "1f1b"
                 and getattr(model, "pp_1f1b_apply_factory", None) is None):
             # mirror the train-path guard: fail with a clear error at
@@ -579,7 +591,8 @@ def build_eval_step(model: Model, cfg: ExperimentConfig, topo: Topology):
                       max(m for m in range(1, cap + 1) if b % m == 0))
             if cfg.mesh.pipeline_schedule == "1f1b":
                 apply_fn = model.pp_1f1b_apply_factory(
-                    topo.stage_axis, m_eval, cfg.mesh.pipeline_chunks)
+                    topo.stage_axis, m_eval, cfg.mesh.pipeline_chunks,
+                    tp_ax)
             else:
                 apply_fn = model.pp_apply_factory(topo.stage_axis, m_eval,
                                                   tp_ax, None, ep_ax)

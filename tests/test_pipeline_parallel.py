@@ -261,27 +261,126 @@ def test_resume_refuses_cross_schedule_layout(tmp_train_dir):
                                "train.max_steps": 4}))
 
 
-def test_1f1b_refuses_tp_sp():
-    cfg = _cfg().override({"mesh.num_replicas": 1,
+@pytest.mark.parametrize("n_replicas,n_stage,n_model,chunks,microbatches", [
+    (2, 2, 2, 2, 2),    # DP × 1F1B × TP
+    (1, 2, 4, 2, 4),    # 1F1B × wide TP
+])
+def test_1f1b_tp_step_matches_dense_update(n_replicas, n_stage, n_model,
+                                           chunks, microbatches):
+    """Gold parity for 1F1B × tensor parallelism: the Megatron
+    row-parallel psums (and the AD-inserted psums for TP-replicated
+    leaves) execute inside the engine's stage-varying switch branches —
+    legal because every model-axis peer group shares one stage
+    coordinate and so takes the same branch each tick."""
+    cfg = _cfg(n_replicas=n_replicas)
+    cfg = cfg.override({"mesh.num_replicas": n_replicas,
+                        "mesh.pipeline_parallelism": n_stage,
+                        "mesh.model_parallelism": n_model,
+                        "mesh.pipeline_microbatches": microbatches,
+                        "mesh.pipeline_schedule": "1f1b",
+                        "mesh.pipeline_chunks": chunks})
+    batch = _tokens(cfg)
+    want_loss, want_params = _dense_update(cfg, batch)
+
+    topo = make_topology(cfg.mesh)
+    model = get_model(cfg.model)
+    specs = state_partition_specs(model, cfg, topo)
+    state = topo.device_put_state(init_train_state(model, cfg, topo), specs)
+    step_fn = build_train_step(model, cfg, topo, constant(LR))
+    state, metrics = step_fn(state, topo.device_put_batch(batch))
+
+    np.testing.assert_allclose(float(metrics["loss"]), float(want_loss),
+                               rtol=2e-5, atol=2e-5)
+    got = jax.device_get(state.params)
+    want_stacked = transformer.stack_block_params_chunked(
+        want_params, n_stage, chunks)
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want_stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-5)
+
+
+@pytest.mark.parametrize("n_replicas,n_stage,n_seq,chunks,microbatches", [
+    (2, 2, 2, 2, 2),    # DP × 1F1B × SP (Ulysses attention in the chunks)
+    (1, 2, 4, 2, 4),    # 1F1B × wide SP
+])
+def test_1f1b_sp_step_matches_dense_update(n_replicas, n_stage, n_seq,
+                                           chunks, microbatches):
+    """Gold parity for 1F1B × sequence parallelism: Ulysses all-to-alls
+    run inside the switch branches (group-local rendezvous over seq
+    peers that share the stage coordinate — ring's global-rendezvous
+    ppermute cannot, see the refusal test), the seed branch computes
+    the cross-shard partial loss against targets shifted OUTSIDE the
+    engine, and the outer psum over the seq axis reassembles the dense
+    update exactly."""
+    cfg = _cfg(n_replicas=n_replicas)
+    cfg = cfg.override({"model.sp_attention": "ulysses",
+                        "mesh.num_replicas": n_replicas,
+                        "mesh.pipeline_parallelism": n_stage,
+                        "mesh.seq_parallelism": n_seq,
+                        "mesh.pipeline_microbatches": microbatches,
+                        "mesh.pipeline_schedule": "1f1b",
+                        "mesh.pipeline_chunks": chunks})
+    batch = _tokens(cfg)
+    want_loss, want_params = _dense_update(cfg, batch)
+
+    topo = make_topology(cfg.mesh)
+    model = get_model(cfg.model)
+    specs = state_partition_specs(model, cfg, topo)
+    state = topo.device_put_state(init_train_state(model, cfg, topo), specs)
+    step_fn = build_train_step(model, cfg, topo, constant(LR))
+    state, metrics = step_fn(state, topo.device_put_batch(batch,
+                                                          seq_sharded=True))
+
+    np.testing.assert_allclose(float(metrics["loss"]), float(want_loss),
+                               rtol=2e-5, atol=2e-5)
+    got = jax.device_get(state.params)
+    want_stacked = transformer.stack_block_params_chunked(
+        want_params, n_stage, chunks)
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want_stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-5)
+
+
+def test_1f1b_refuses_ep():
+    """Expert parallelism is the matrix's one remaining 1f1b gap (the
+    fused engine does not accumulate routing statistics yet)."""
+    cfg = _cfg().override({"model.num_experts": 4,
+                           "mesh.num_replicas": 1,
                            "mesh.pipeline_parallelism": 2,
-                           "mesh.model_parallelism": 2,
+                           "mesh.expert_parallelism": 2,
                            "mesh.pipeline_schedule": "1f1b",
                            "mesh.pipeline_chunks": 2})
-    with pytest.raises(ValueError, match="1f1b"):
+    with pytest.raises(ValueError, match="1f1b|expert"):
+        build_train_step(get_model(cfg.model), cfg, make_topology(cfg.mesh),
+                         constant(LR))
+
+
+def test_1f1b_sp_refuses_ring_attention():
+    """Ring attention's ppermute rendezvouses globally — inside the
+    fused engine's stage-varying branches it would deadlock, so the
+    registry refuses the combination up front (Ulysses composes)."""
+    cfg = _cfg().override({"model.sp_attention": "ring",
+                           "mesh.num_replicas": 1,
+                           "mesh.pipeline_parallelism": 2,
+                           "mesh.seq_parallelism": 2,
+                           "mesh.pipeline_microbatches": 2,
+                           "mesh.pipeline_schedule": "1f1b",
+                           "mesh.pipeline_chunks": 2})
+    with pytest.raises(ValueError, match="ulysses"):
         build_train_step(get_model(cfg.model), cfg, make_topology(cfg.mesh),
                          constant(LR))
 
 
 def test_trainer_end_to_end_1f1b(tmp_train_dir):
-    """Full Trainer on (replica=2, stage=2, chunks=2): training,
-    checkpoint/resume with the chunk-interleaved layout, and eval
-    through the chunked-ring forward."""
+    """Full Trainer on (replica=2, stage=2, model=2): training,
+    checkpoint/resume with the chunk-interleaved TP-sharded layout, and
+    eval through the chunked-ring forward with Megatron shards."""
     from distributedmnist_tpu.train.loop import Trainer
 
     cfg = _cfg(n_replicas=2)
     cfg = cfg.override({
         "mesh.num_replicas": 2, "mesh.pipeline_parallelism": 2,
-        "mesh.pipeline_microbatches": 2,
+        "mesh.model_parallelism": 2, "mesh.pipeline_microbatches": 2,
         "mesh.pipeline_schedule": "1f1b", "mesh.pipeline_chunks": 2,
         "train.max_steps": 10, "train.train_dir": tmp_train_dir,
         "train.log_every_steps": 5, "train.save_interval_secs": 0,
