@@ -85,6 +85,19 @@ class ModelConfig:
     num_experts: int = 0
     expert_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # Tokens are routed in fixed per-row groups: each sequence row
+    # splits into moe_num_groups contiguous chunks, and capacity +
+    # load-balance aux are computed per chunk (GShard group routing).
+    # Groups nest inside rows, so routing semantics are invariant to
+    # the pipeline microbatch split. 0 = auto: the minimum the mesh
+    # requires (one group per expert rank per seq shard per row) —
+    # convenient, but mesh-dependent; set explicitly for numerics that
+    # are identical across every mesh (the gold-parity tests do).
+    moe_num_groups: int = 0
+    # 1 = Switch top-1 (gate = raw top prob); ≥2 = GShard top-k with
+    # renormalized gates and sequential capacity filling (round k's
+    # queue positions start after all earlier rounds' claims).
+    moe_router_top_k: int = 1
     # Rematerialize each transformer block in the backward pass
     # (jax.checkpoint): activation memory per layer drops from O(all
     # intermediates) to O(block boundary), bought with one extra

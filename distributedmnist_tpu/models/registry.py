@@ -199,6 +199,8 @@ def _transformer(cfg: ModelConfig) -> Model:
                                  compute_dtype=compute_dtype,
                                  num_experts=cfg.num_experts,
                                  capacity_factor=cfg.expert_capacity_factor,
+                                 moe_num_groups=cfg.moe_num_groups,
+                                 moe_router_top_k=cfg.moe_router_top_k,
                                  remat=cfg.remat,
                                  return_aux=return_aux)
 
@@ -253,6 +255,8 @@ def _transformer(cfg: ModelConfig) -> Model:
                                      expert_axis=expert_axis,
                                      num_experts=cfg.num_experts,
                                      capacity_factor=cfg.expert_capacity_factor,
+                                     moe_num_groups=cfg.moe_num_groups,
+                                     moe_router_top_k=cfg.moe_router_top_k,
                                      remat=cfg.remat,
                                      moe_stats_axes=stats_axes,
                                      return_aux=return_aux)
@@ -281,6 +285,8 @@ def _transformer(cfg: ModelConfig) -> Model:
                 model_axis=model_axis, expert_axis=expert_axis,
                 num_experts=cfg.num_experts,
                 capacity_factor=cfg.expert_capacity_factor,
+                moe_num_groups=cfg.moe_num_groups,
+                moe_router_top_k=cfg.moe_router_top_k,
                 moe_stats_axes=stats_axes,
                 compute_dtype=compute_dtype, remat=cfg.remat,
                 return_aux=return_aux)
@@ -294,12 +300,6 @@ def _transformer(cfg: ModelConfig) -> Model:
         if expert_axis is not None and not moe:
             raise ValueError("mesh has expert parallelism but the model has "
                              "no experts (model.num_experts == 0)")
-        if moe:
-            raise ValueError(
-                "mixture-of-experts does not compose with the 1f1b "
-                "pipeline schedule yet (the fused engine does not "
-                "accumulate routing statistics); use "
-                "mesh.pipeline_schedule='gpipe', which supports MoE")
         if seq_axis is not None and cfg.sp_attention == "ring":
             raise ValueError(
                 "pipeline_schedule='1f1b' with sequence parallelism "
@@ -315,18 +315,32 @@ def _transformer(cfg: ModelConfig) -> Model:
                 stage_axis=stage_axis, num_microbatches=num_microbatches,
                 num_chunks=num_chunks, attention_fn=pp_attn,
                 model_axis=model_axis, seq_axis=seq_axis,
+                expert_axis=expert_axis, num_experts=cfg.num_experts,
+                capacity_factor=cfg.expert_capacity_factor,
+                moe_num_groups=cfg.moe_num_groups,
+                moe_router_top_k=cfg.moe_router_top_k,
+                aux_weight=cfg.moe_aux_weight,
                 compute_dtype=compute_dtype)
         return grads_fn
 
     def pp_1f1b_apply_factory(stage_axis: str, num_microbatches: int,
                               num_chunks: int,
-                              model_axis: str | None = None):
+                              model_axis: str | None = None,
+                              expert_axis: str | None = None):
+        if expert_axis is not None and not moe:
+            raise ValueError("mesh has expert parallelism but the model has "
+                             "no experts (model.num_experts == 0)")
+
         def apply_1f1b(params, tokens):
             return transformer.apply_pp_1f1b(
                 params, tokens, num_heads=cfg.num_heads,
                 stage_axis=stage_axis, num_microbatches=num_microbatches,
                 num_chunks=num_chunks, attention_fn=attention_fn,
                 model_axis=model_axis,
+                expert_axis=expert_axis, num_experts=cfg.num_experts,
+                capacity_factor=cfg.expert_capacity_factor,
+                moe_num_groups=cfg.moe_num_groups,
+                moe_router_top_k=cfg.moe_router_top_k,
                 compute_dtype=compute_dtype)
         return apply_1f1b
 

@@ -121,6 +121,7 @@ def apply(params: Params, tokens: jax.Array, *, num_heads: int = 4,
           model_axis: str | None = None,
           expert_axis: str | None = None, num_experts: int = 0,
           capacity_factor: float = 1.25, remat: bool = False,
+          moe_num_groups: int = 0, moe_router_top_k: int = 1,
           moe_stats_axes: tuple[str, ...] = (),
           return_aux: bool = False) -> jax.Array:
     """tokens [batch, seq] int32 → logits [batch, seq, vocab] float32.
@@ -165,6 +166,8 @@ def apply(params: Params, tokens: jax.Array, *, num_heads: int = 4,
                             expert_axis=expert_axis,
                             num_experts=num_experts,
                             capacity_factor=capacity_factor,
+                            moe_num_groups=moe_num_groups,
+                            moe_router_top_k=moe_router_top_k,
                             moe_stats_axes=moe_stats_axes)
 
     if remat:
@@ -184,13 +187,14 @@ def _apply_block(x: jax.Array, blk: Params, *, h_local: int, hd: int,
                  attn: Callable, model_axis: str | None,
                  expert_axis: str | None = None, num_experts: int = 0,
                  capacity_factor: float = 1.25,
-                 moe_stats_axes: tuple[str, ...] = (),
-                 moe_return_stats: bool = False) -> tuple[jax.Array, jax.Array]:
-    """One pre-norm transformer block (shared by the dense/TP loop and
-    the pipeline stage scan). Returns (x, moe_aux_loss) — aux is 0 for
-    dense-FFN blocks. With ``moe_return_stats`` the second element is
-    the raw routing statistics pair instead (the pipeline accumulates
-    them across microbatch ticks before forming the aux)."""
+                 moe_num_groups: int = 0, moe_router_top_k: int = 1,
+                 moe_stats_axes: tuple[str, ...] = ()) -> tuple[jax.Array, jax.Array]:
+    """One pre-norm transformer block (shared by the dense/TP loop, the
+    pipeline stage scans, and the 1F1B chunk bodies). Returns
+    (x, moe_aux) — aux is 0 for dense-FFN blocks, else the mean
+    per-group load-balance loss of this block's routing (linear across
+    blocks/ticks/shards: callers sum over layers and average over
+    microbatches)."""
     b = x.shape[0]
     h = _rms_norm(x, blk["ln1"])
     qkv = jnp.einsum("bsd,dte->bste", h, blk["wqkv"])  # e = d/m
@@ -211,10 +215,11 @@ def _apply_block(x: jax.Array, blk: Params, *, h_local: int, hd: int,
         mlp, aux = moe_ffn(h, blk["router"], blk["w1"], blk["w2"],
                            num_experts=num_experts,
                            capacity_factor=capacity_factor,
+                           router_top_k=moe_router_top_k,
+                           num_groups=moe_num_groups,
                            expert_axis=expert_axis,
                            tp_axis=model_axis,
-                           stats_axes=moe_stats_axes,
-                           return_stats=moe_return_stats)
+                           stats_axes=moe_stats_axes)
     else:
         mlp = jax.nn.relu(h @ blk["w1"]) @ blk["w2"]
         aux = jnp.zeros((), jnp.float32)
@@ -280,6 +285,7 @@ def apply_pp(params: Params, tokens: jax.Array, *, num_heads: int,
              model_axis: str | None = None,
              expert_axis: str | None = None, num_experts: int = 0,
              capacity_factor: float = 1.25,
+             moe_num_groups: int = 0, moe_router_top_k: int = 1,
              moe_stats_axes: tuple[str, ...] = (),
              compute_dtype=jnp.bfloat16, remat: bool = False,
              return_aux: bool = False) -> jax.Array:
@@ -305,17 +311,16 @@ def apply_pp(params: Params, tokens: jax.Array, *, num_heads: int,
 
     Mixture-of-experts (``num_experts > 0``, optionally expert-sharded
     over ``expert_axis``) composes too: each tick's MoE calls run the
-    grouped dispatch on that microbatch's tokens (capacity is
-    microbatch-local — the shard-local-capacity norm of ops/moe.py),
-    all-to-alls lockstep across stages since every device runs every
-    tick. The aux loss cannot be summed per tick (E·Σ frac·mprob is
-    nonlinear in the statistics), so each block's RAW routing stats are
-    accumulated across the real microbatch ticks (pipeline_apply
-    ``with_stats``) and the aux is formed from the batch-mean stats —
-    exactly the dense full-batch value. ``return_aux`` returns it.
-    ``moe_stats_axes``: extra token-sharding axes (the seq axis under
-    PP×SP×EP) the per-call routing statistics additionally average
-    over, keeping that exactness when each call sees a token slice.
+    grouped dispatch on that microbatch's tokens, all-to-alls lockstep
+    across stages since every device runs every tick. Token groups nest
+    inside sequence rows (ops/moe.py), so routing capacity, drops, and
+    the per-group aux are IDENTICAL for every microbatch count — the
+    aux is linear in per-group contributions, so each real tick's aux
+    simply accumulates (pipeline_apply ``with_stats``, bubbles masked)
+    and the mean over microbatches equals the dense full-batch value
+    exactly. ``return_aux`` returns it. ``moe_stats_axes``: extra
+    token-sharding axes (the seq axis under PP×SP×EP) each call's aux
+    additionally pmeans over.
     """
     from ..ops.pipeline import pipeline_apply
 
@@ -341,30 +346,30 @@ def apply_pp(params: Params, tokens: jax.Array, *, num_heads: int,
 
     def stage_fn(act):
         def layer(carry, blk):
-            out, st = _apply_block(carry, blk, h_local=num_heads // m,
-                                   hd=hd, attn=attn, model_axis=model_axis,
-                                   expert_axis=expert_axis,
-                                   num_experts=num_experts,
-                                   capacity_factor=capacity_factor,
-                                   moe_stats_axes=moe_stats_axes,
-                                   moe_return_stats=moe)
-            return out, (st if moe else None)
+            out, aux_l = _apply_block(carry, blk, h_local=num_heads // m,
+                                      hd=hd, attn=attn,
+                                      model_axis=model_axis,
+                                      expert_axis=expert_axis,
+                                      num_experts=num_experts,
+                                      capacity_factor=capacity_factor,
+                                      moe_num_groups=moe_num_groups,
+                                      moe_router_top_k=moe_router_top_k,
+                                      moe_stats_axes=moe_stats_axes)
+            return out, (aux_l if moe else None)
 
         if remat:
             layer = jax.checkpoint(layer)
-        out, stats = lax.scan(layer, act, p["blocks"])
-        # stats: per-layer (frac, mprob) [L_local, E] pairs (MoE only)
-        return (out, stats) if moe else out
+        out, aux_layers = lax.scan(layer, act, p["blocks"])
+        # aux_layers: per-LOCAL-layer mean-per-group aux [L_local] (MoE)
+        return (out, aux_layers) if moe else out
 
     if moe:
-        out, (fracs, mprobs) = pipeline_apply(stage_fn, micro, stage_axis,
-                                              with_stats=True)
-        # batch-mean stats per LOCAL layer → this stage's aux share;
+        out, aux_layers = pipeline_apply(stage_fn, micro, stage_axis,
+                                         with_stats=True)
+        # pipeline_apply averaged each layer's aux over the real ticks
+        # (= over microbatches — exact, the aux is per-group linear);
         # stages hold disjoint layers, so one psum totals the model
-        aux = lax.psum(
-            num_experts * jnp.sum(fracs.astype(jnp.float32)
-                                  * mprobs.astype(jnp.float32)),
-            stage_axis)
+        aux = lax.psum(jnp.sum(aux_layers.astype(jnp.float32)), stage_axis)
     else:
         out = pipeline_apply(stage_fn, micro, stage_axis)
         aux = jnp.zeros((), jnp.float32)
@@ -407,6 +412,10 @@ def grads_pp_1f1b(params: Params, tokens: jax.Array, labels: jax.Array, *,
                   num_chunks: int, attention_fn: Callable | None = None,
                   model_axis: str | None = None,
                   seq_axis: str | None = None,
+                  expert_axis: str | None = None, num_experts: int = 0,
+                  capacity_factor: float = 1.25,
+                  moe_num_groups: int = 0, moe_router_top_k: int = 1,
+                  aux_weight: float = 0.0,
                   compute_dtype=jnp.bfloat16):
     """Fused interleaved-1F1B training step body (inside shard_map,
     params in the chunk-interleaved stacked layout of
@@ -443,6 +452,13 @@ def grads_pp_1f1b(params: Params, tokens: jax.Array, labels: jax.Array, *,
     PARTIALS (normalized so a psum over the seq axis reassembles the
     exact dense values — same contract as the GPipe PP×SP path); the
     caller performs that psum.
+
+    ``expert_axis``/``num_experts`` compose mixture-of-experts: the
+    per-row-group aux (ops/moe.py) is LINEAR across chunks and
+    microbatches, so each chunk returns its summed layer aux, the
+    engine accumulates it over forward works and seeds each backward
+    chunk's aux output with the constant weight — no cross-chunk
+    statistics. The returned loss includes the aux term.
     """
     from ..ops.pipeline import pipeline_1f1b_grads
 
@@ -476,13 +492,23 @@ def grads_pp_1f1b(params: Params, tokens: jax.Array, labels: jax.Array, *,
     chunk_params = jax.tree.map(
         lambda a: a.reshape((num_chunks, per) + a.shape[1:]), p["blocks"])
 
+    moe = num_experts > 0
+    moe_stats_axes = (seq_axis,) if (moe and seq_axis is not None) else ()
+
     def chunk_fn(slot_params, act):
         def layer(carry, blk):
-            out, _aux = _apply_block(carry, blk, h_local=num_heads // m_tp,
-                                     hd=hd, attn=attn, model_axis=model_axis)
-            return out, None
-        out, _ = lax.scan(layer, act, slot_params)
-        return out
+            out, aux_l = _apply_block(carry, blk, h_local=num_heads // m_tp,
+                                      hd=hd, attn=attn,
+                                      model_axis=model_axis,
+                                      expert_axis=expert_axis,
+                                      num_experts=num_experts,
+                                      capacity_factor=capacity_factor,
+                                      moe_num_groups=moe_num_groups,
+                                      moe_router_top_k=moe_router_top_k,
+                                      moe_stats_axes=moe_stats_axes)
+            return out, (aux_l if moe else None)
+        out, aux_layers = lax.scan(layer, act, slot_params)
+        return (out, jnp.sum(aux_layers)) if moe else out
 
     labels_mb = labels.reshape(M, mb, s_loc)
     head_params = {"embed": p["embed"], "final_norm": p["final_norm"]}
@@ -510,17 +536,26 @@ def grads_pp_1f1b(params: Params, tokens: jax.Array, labels: jax.Array, *,
             x = _rms_norm(y, hp["final_norm"])
             logits = (x @ hp["embed"].T).astype(jnp.float32)
             tgt = lax.dynamic_index_in_dim(tgt_mb, m, 0, keepdims=False)
-            w = (positions < s_global - 1).astype(jnp.float32)[None, :]
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-            correct = (jnp.argmax(logp, axis=-1) == tgt).astype(jnp.float32)
-            total = mb * (s_global - 1)  # this microbatch's global count
-            return (jnp.sum(nll * w) / total,
-                    jnp.sum(correct * w) / total)
+            # this microbatch's global valid-token count normalizes the
+            # partials (shared kernel with the GPipe/DP SP loss path)
+            return sp_partial_token_loss(logits, tgt, positions, s_global,
+                                         mb * (s_global - 1))
 
-    losses, accs, dinputs, dchunk, dhead = pipeline_1f1b_grads(
-        chunk_fn, head_fn, chunk_params, head_params, micro,
-        stage_axis, num_chunks)
+    # The backward aux seed is the FULL weight: the aux primal is the
+    # pmean over (expert, seq) of per-shard contributions, and the
+    # pmean's transpose (cotangent/n per shard) composed with the
+    # caller's psum-over-seq of grads already yields exactly
+    # aux_weight·d(aux)/dθ — pre-dividing the SEED (as the loss VALUE
+    # must be, below) would undercount aux gradients by n_seq.
+    if moe:
+        losses, accs, dinputs, dchunk, dhead, aux_sum = pipeline_1f1b_grads(
+            chunk_fn, head_fn, chunk_params, head_params, micro,
+            stage_axis, num_chunks, with_aux=True,
+            aux_cotangent=aux_weight)
+    else:
+        losses, accs, dinputs, dchunk, dhead = pipeline_1f1b_grads(
+            chunk_fn, head_fn, chunk_params, head_params, micro,
+            stage_axis, num_chunks)
     # the engine seeds every microbatch's loss with cotangent 1.0 (sum
     # convention); the step's loss is the MEAN over microbatches
     scale = 1.0 / M
@@ -539,21 +574,32 @@ def grads_pp_1f1b(params: Params, tokens: jax.Array, labels: jax.Array, *,
     # the engine differentiates the compute-dtype cast of the params;
     # apply the cast's transpose so grads match the master param dtypes
     grads = jax.tree.map(lambda g, p0: g.astype(p0.dtype), grads, params)
-    return jnp.mean(losses), jnp.mean(accs), grads
+    loss = jnp.mean(losses)
+    if moe:
+        # the VALUE term pre-divides by n_seq (the aux is already the
+        # full pmean'd value on every shard; the caller's psum over the
+        # seq axis reassembles exactly one copy — make_sp_loss's
+        # aux/n_seq convention)
+        loss = loss + (aux_weight / n_seq) * aux_sum * scale
+    return loss, jnp.mean(accs), grads
 
 
 def apply_pp_1f1b(params: Params, tokens: jax.Array, *, num_heads: int,
                   stage_axis: str, num_microbatches: int, num_chunks: int,
                   attention_fn: Callable | None = None,
                   model_axis: str | None = None,
+                  expert_axis: str | None = None, num_experts: int = 0,
+                  capacity_factor: float = 1.25,
+                  moe_num_groups: int = 0, moe_router_top_k: int = 1,
                   compute_dtype=jnp.bfloat16) -> jax.Array:
     """Forward-only apply for the chunk-interleaved layout (eval under
     schedule="1f1b"): the chunked ring (ops/pipeline.py:
     pipeline_chunked_forward) with embedding/head outside, same
     contract as :func:`apply_pp`. ``model_axis`` composes Megatron TP
-    inside each chunk — the forward ring computes every chunk
-    unconditionally (``jnp.where`` select, not a branch), so the TP
-    psums run lockstep on every device every tick."""
+    and ``expert_axis`` MoE expert sharding inside each chunk — the
+    forward ring computes every chunk unconditionally (``jnp.where``
+    select, not a branch), so the TP psums / EP all-to-alls run
+    lockstep on every device every tick."""
     from ..ops.pipeline import pipeline_chunked_forward
 
     attn = attention_fn or local_self_attention
@@ -583,7 +629,13 @@ def apply_pp_1f1b(params: Params, tokens: jax.Array, *, num_heads: int,
 
         def layer(carry, blk):
             out, _aux = _apply_block(carry, blk, h_local=num_heads // m_tp,
-                                     hd=hd, attn=attn, model_axis=model_axis)
+                                     hd=hd, attn=attn,
+                                     model_axis=model_axis,
+                                     expert_axis=expert_axis,
+                                     num_experts=num_experts,
+                                     capacity_factor=capacity_factor,
+                                     moe_num_groups=moe_num_groups,
+                                     moe_router_top_k=moe_router_top_k)
             return out, None
         out, _ = lax.scan(layer, act, slot_params)
         return out
@@ -593,6 +645,29 @@ def apply_pp_1f1b(params: Params, tokens: jax.Array, *, num_heads: int,
     x = _rms_norm(x, p["final_norm"])
     logits = x @ p["embed"].T
     return logits.astype(jnp.float32)
+
+
+def sp_partial_token_loss(logits: jax.Array, tgt: jax.Array,
+                          positions: jax.Array, s_global: int,
+                          total: int) -> tuple[jax.Array, jax.Array]:
+    """The sequence-parallel partial next-token (loss, accuracy) kernel
+    — the ONE implementation both SP consumers share (the train step's
+    ``make_sp_loss`` in parallel/api.py and the 1F1B engine's seed-tick
+    head above), so the masking/normalization conventions cannot drift
+    between schedules.
+
+    Args: ``logits`` [b, s_loc, V] this shard's logits; ``tgt``
+    [b, s_loc] the already-shifted global targets (the caller fetches
+    the cross-shard column); ``positions`` this shard's global
+    positions; ``total`` the GLOBAL valid-token count the partial sums
+    normalize by — psum over the seq axis of the returned pair equals
+    the dense ``loss_fn``/``accuracy`` exactly.
+    """
+    w = (positions < s_global - 1).astype(jnp.float32)[None, :]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    correct = (jnp.argmax(logp, axis=-1) == tgt).astype(jnp.float32)
+    return jnp.sum(nll * w) / total, jnp.sum(correct * w) / total
 
 
 def loss_fn(logits: jax.Array, labels: jax.Array) -> jax.Array:

@@ -1,39 +1,41 @@
 """Mixture-of-experts FFN with expert parallelism.
 
 The fifth parallelism family (data/tensor/sequence/pipeline/expert —
-all absent from the reference, SURVEY §2.2). Switch-Transformer-style
-top-1 routing with a fixed per-expert capacity and a load-balancing
-auxiliary loss (cf. arXiv:2101.03961), in the GShard dispatch/combine
-einsum formulation (arXiv:2006.16668) — static shapes throughout, so
-XLA sees two dense batched matmuls per expert shard and the MXU stays
-busy regardless of routing.
+all absent from the reference, SURVEY §2.2). Switch-Transformer top-1
+and GShard top-k routing (cf. arXiv:2101.03961, arXiv:2006.16668) in
+the dispatch/combine einsum formulation — static shapes throughout, so
+XLA sees dense batched matmuls per expert shard and the MXU stays busy
+regardless of routing.
 
-Expert-parallel layout (GShard all-to-all dispatch): the expert axis
-doubles as a token-group axis inside the MoE block. Each rank slices
-its 1/G of the (replicated) token set — free, no collective — routes
-those tokens locally with SHARD-LOCAL capacity ceil(cf·t_g/E), and two
+Token groups (the GShard "group" dimension): every sequence row splits
+into a fixed number of contiguous chunks, and routing capacity plus the
+load-balance auxiliary loss are computed PER CHUNK. Because groups nest
+inside rows, the routing math depends only on (config, row contents) —
+never on how a batch is split into pipeline microbatches, how many
+expert ranks exist, or how the sequence is sharded (given an explicit
+``num_groups``). Consequences the tests pin down:
+
+* a pipelined (PP) MoE evaluates/trains identically at ANY microbatch
+  count — groups never straddle a microbatch boundary;
+* an expert-parallel run equals the dense oracle EXACTLY, including
+  with binding capacity (same groups → same drops);
+* the aux loss is the MEAN over groups of the per-group Switch loss
+  E·Σ_e frac_e·mprob_e — linear in per-group contributions, so
+  pipeline ticks / seq shards / expert ranks can average it without
+  the round-4 raw-statistics accumulation machinery.
+
+Expert-parallel layout (GShard all-to-all dispatch): each expert rank
+owns a contiguous 1/G slice of every row's groups — a free local slice
+of the replicated activations. It routes those groups locally and two
 ``lax.all_to_all``s carry only the dispatched capacity slices
-[E_local, G·C_g, d] to the expert owners and back. Routing and the
-dispatch/combine einsums therefore run over t/G tokens per rank
-(the round-3 layout ran them redundantly over all t on every rank).
-The combined group outputs are reassembled replicated via the
-framework's scatter+psum idiom (parallel/api.py:_gather_replicated —
-an ``all_gather`` result stays tracked device-varying and could not
-feed the replicated residual stream), fused over the expert and TP
-axes in one reduction.
-
-Capacity semantics: capacity is LOCAL to each token group — a group
-whose tokens concentrate on one expert drops tokens that would have
-fit under global capacity. This is the documented GShard trade (group-
-local dispatch keeps every shape static and the collectives capacity-
-sized); with ``capacity_factor ≥ E/…`` such that C_g ≥ t_g nothing can
-ever drop and the EP output equals the dense oracle exactly
-(tests/test_moe.py gold-parity tests).
-
-The load-balance statistics are averaged over the expert axis (and any
-``stats_axes``, e.g. the sequence axis under SP×EP) BEFORE forming the
-aux loss, so ``aux`` equals the dense computation over the full token
-set exactly — group-local aux would bias toward per-group imbalance.
+[n_groups, E_local, G·cap, d] to the expert owners and back. The
+combined outputs are reassembled replicated via the framework's
+scatter+psum idiom (parallel/api.py:_gather_replicated — an
+``all_gather`` result stays tracked device-varying and could not feed
+the replicated residual stream), fused over the expert and TP axes in
+one reduction. all_to_all / psum rendezvous GROUP-locally, which is
+what lets this op run inside the 1F1B engine's stage-varying branches
+(ops/pipeline.py).
 """
 
 from __future__ import annotations
@@ -45,22 +47,49 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _route(xg: jax.Array, router_w: jax.Array, e: int, cap: int):
-    """Top-1 routing over one token group [t, d] → dispatch/combine
-    [t, e, cap] (f32) plus per-expert load statistics [e]."""
+def _route(xg: jax.Array, router_w: jax.Array, e: int, cap: int,
+           top_k: int):
+    """Route one token group [t, d] → dispatch/combine [t, e, cap]
+    (f32) plus per-expert load statistics [e].
+
+    ``top_k == 1``: Switch routing — the token's combine weight is its
+    raw top gate. ``top_k >= 2``: GShard — each round dispatches the
+    next-best expert, queue positions offset by ALL earlier rounds'
+    claims (kept or dropped, matching GShard's ``locations2 += sum
+    (mask1)``), and gates renormalize over the chosen set, so a token
+    whose first choice overflowed still flows through its second.
+    """
     logits = (xg @ router_w.astype(xg.dtype)).astype(jnp.float32)  # [t, e]
     probs = jax.nn.softmax(logits, axis=-1)
-    gate = jnp.max(probs, axis=-1)                    # [t]
-    choice = jnp.argmax(probs, axis=-1)               # [t]
-    onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # [t, e]
-    # position of each token within its expert's queue (0-based);
-    # tokens past capacity get a zero dispatch row (dropped -> residual)
-    pos = jnp.sum((jnp.cumsum(onehot, axis=0) - 1.0) * onehot,
-                  axis=-1).astype(jnp.int32)          # [t]
-    slot = jax.nn.one_hot(pos, cap, dtype=jnp.float32)  # [t, cap]
-    dispatch = onehot[:, :, None] * slot[:, None, :]    # [t, e, cap]
-    combine = dispatch * gate[:, None, None]
-    return dispatch, combine, jnp.mean(onehot, axis=0), jnp.mean(probs, axis=0)
+    remaining = probs
+    counts = jnp.zeros((e,), jnp.float32)   # queue claims so far
+    disps, gates = [], []
+    for _ in range(top_k):
+        gate_k = jnp.max(remaining, axis=-1)              # [t]
+        choice = jnp.argmax(remaining, axis=-1)           # [t]
+        oh = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # [t, e]
+        # position within the expert's queue: this round's arrival
+        # order plus every earlier round's total claims on that expert
+        pos = (jnp.sum((jnp.cumsum(oh, axis=0) - 1.0) * oh, axis=-1)
+               + oh @ counts).astype(jnp.int32)           # [t]
+        slot = jax.nn.one_hot(pos, cap, dtype=jnp.float32)  # 0 if pos>=cap
+        disps.append(oh[:, :, None] * slot[:, None, :])     # [t, e, cap]
+        gates.append(gate_k)
+        counts = counts + jnp.sum(oh, axis=0)
+        remaining = remaining * (1.0 - oh)
+    dispatch = disps[0] if top_k == 1 else sum(disps)
+    if top_k == 1:
+        combine = disps[0] * gates[0][:, None, None]
+    else:
+        denom = sum(gates) + 1e-9
+        combine = sum((g / denom)[:, None, None] * dk
+                      for g, dk in zip(gates, disps))
+    # load statistics use FIRST-choice fractions (the Switch/GShard
+    # aux convention, independent of later rounds' capacity outcomes)
+    frac = jnp.mean(jax.nn.one_hot(jnp.argmax(probs, axis=-1), e,
+                                   dtype=jnp.float32), axis=0)
+    mprob = jnp.mean(probs, axis=0)
+    return dispatch, combine, frac, mprob
 
 
 def _expert_ffn(expert_in: jax.Array, w1: jax.Array, w2: jax.Array,
@@ -79,11 +108,11 @@ def _expert_ffn(expert_in: jax.Array, w1: jax.Array, w2: jax.Array,
 
 def moe_ffn(x: jax.Array, router_w: jax.Array, w1: jax.Array, w2: jax.Array,
             *, num_experts: int, capacity_factor: float = 1.25,
+            router_top_k: int = 1, num_groups: int = 0,
             expert_axis: str | None = None,
             tp_axis: str | None = None,
-            stats_axes: tuple[str, ...] = (),
-            return_stats: bool = False) -> tuple[jax.Array, jax.Array]:
-    """Top-1 routed expert FFN.
+            stats_axes: tuple[str, ...] = ()) -> tuple[jax.Array, jax.Array]:
+    """Top-k routed expert FFN over fixed per-row token groups.
 
     Args (inside shard_map when ``expert_axis``/``tp_axis`` are set):
       x: [batch, seq, d] activations (replicated over both axes; under
@@ -97,81 +126,124 @@ def moe_ffn(x: jax.Array, router_w: jax.Array, w1: jax.Array, w2: jax.Array,
         expert's FFN across the model axis, and ONE fused psum over
         both axes reassembles the combined output.
       num_experts: E (global).
-      capacity_factor: per-group capacity = ceil(cf · t_group / E);
-        overflow tokens pass through the residual unchanged (their
-        combine weight is zero). Under EP the group is this rank's t/G
-        token slice — capacity is shard-local (module docstring).
-      stats_axes: extra mesh axes whose token shards the load-balance
-        statistics must average over (the seq axis under SP), so the
-        aux loss matches the dense full-token computation exactly.
-      return_stats: return the RAW averaged routing statistics
-        ``(frac, mean_prob)`` (each [E]) instead of the aux scalar —
-        for callers that see only a token SLICE per call (the pipeline
-        processing one microbatch per tick) and must average the
-        statistics across calls BEFORE forming the aux product, since
-        E·Σ frac·mprob is not linear in the statistics.
+      capacity_factor: per-group capacity =
+        ceil(cf · top_k · group_size / E); overflow tokens lose that
+        round's slot (pass through the residual, or — top-k — flow
+        through a later choice).
+      router_top_k: experts per token (module docstring).
+      num_groups: chunks per GLOBAL sequence row (module docstring);
+        the per-call group count divides out any seq sharding named in
+        ``stats_axes``. 0 = auto: the minimum this call's sharding
+        requires (one group per expert rank, or one group per row
+        unsharded) — mesh-dependent, so fixed-mesh comparisons set it
+        explicitly.
+      stats_axes: extra mesh axes the sequence is sharded over (the seq
+        axis under SP): the aux pmean runs over them, and the global
+        ``num_groups`` is interpreted per global row across them.
 
-    Returns (out [batch, seq, d], aux): ``aux`` is the Switch
-    load-balancing loss E·Σ_e(fraction_e · mean_prob_e), ≈1 when
-    perfectly balanced; add ``aux_weight * aux`` to the train loss.
-    With ``return_stats``, (out, (frac [E], mean_prob [E])) instead.
+    Returns (out [batch, seq, d], aux): ``aux`` is the mean over token
+    groups of the per-group Switch load-balance loss
+    E·Σ_e(fraction_e · mean_prob_e), pmean'd over the expert axis and
+    ``stats_axes`` — i.e. the mean over ALL of this layer's groups,
+    replicated; add ``aux_weight * aux`` to the train loss.
     """
     b, s, d = x.shape
-    t = b * s
     e = num_experts
-    xf = x.reshape(t, d)
+    if not 1 <= router_top_k <= e:
+        raise ValueError(f"moe_router_top_k={router_top_k} must be in "
+                         f"[1, num_experts={e}]")
     # routing math stays f32 (inside _route); the FFN FLOPs run in the
     # compute dtype like the dense branch (bf16 feeds the MXU full-rate)
     dtype = x.dtype
 
+    g_ep = 1
+    if expert_axis is not None:
+        e_local = w1.shape[0]
+        g_ep = e // e_local                       # expert-axis size
+    n_seq_shards = 1
+    for ax in stats_axes:
+        n_seq_shards *= lax.axis_size(ax)
+
+    if num_groups:
+        if num_groups % n_seq_shards:
+            raise ValueError(
+                f"moe_num_groups={num_groups} must divide by the "
+                f"sequence sharding ({n_seq_shards} shards) so group "
+                "boundaries align with shard boundaries")
+        gh = num_groups // n_seq_shards           # groups per local row
+    else:
+        gh = g_ep                                 # auto: one per EP rank
+    if gh % g_ep:
+        raise ValueError(
+            f"per-shard group count {gh} (moe_num_groups="
+            f"{num_groups or 'auto'}) must divide by the expert-parallel "
+            f"rank count {g_ep}")
+    if s % gh:
+        raise ValueError(
+            f"local sequence length {s} must divide into {gh} token "
+            f"groups (moe_num_groups={num_groups or 'auto'})")
+    gs = s // gh                                  # tokens per group
+    cap = max(1, math.ceil(capacity_factor * router_top_k * gs / e))
+
+    def route_many(xg):                           # [n_g, gs, d]
+        return jax.vmap(lambda g: _route(g, router_w, e, cap,
+                                         router_top_k))(xg)
+
     if expert_axis is None:
-        cap = max(1, math.ceil(capacity_factor * t / e))
-        dispatch, combine, frac, mprob = _route(xf, router_w, e, cap)
-        expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(dtype), xf)
-        expert_out = _expert_ffn(expert_in, w1, w2, dtype)   # [e, cap, d]
-        out = jnp.einsum("tec,ecd->td", combine.astype(dtype), expert_out)
+        n_g = b * gh
+        xg = x.reshape(n_g, gs, d)
+        dispatch, combine, frac, mprob = route_many(xg)
+        # experts see each group's capacity slots independently
+        expert_in = jnp.einsum("gtec,gtd->gecd", dispatch.astype(dtype), xg)
+        ei = expert_in.transpose(1, 0, 2, 3).reshape(e, n_g * cap, d)
+        eo = _expert_ffn(ei, w1, w2, dtype)
+        expert_out = eo.reshape(e, n_g, cap, d).transpose(1, 0, 2, 3)
+        out = jnp.einsum("gtec,gecd->gtd", combine.astype(dtype), expert_out)
+        out = out.reshape(b, s, d)
         if tp_axis is not None:
             out = lax.psum(out, tp_axis)
     else:
-        e_local = w1.shape[0]
-        g = e // e_local                  # expert-axis size (static)
-        if t % g:
-            raise ValueError(
-                f"MoE token count {t} (batch {b} × seq {s}) must divide "
-                f"by the expert-parallel group count {g}")
-        t_g = t // g
         me = lax.axis_index(expert_axis)
-        # this rank's token group — a local slice of the replicated set
-        xg = lax.dynamic_slice_in_dim(xf, me * t_g, t_g, axis=0)
-        cap = max(1, math.ceil(capacity_factor * t_g / e))   # shard-local
-        dispatch, combine, frac, mprob = _route(xg, router_w, e, cap)
-        expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(dtype), xg)
-        # all-to-all #1: [E, C_g, d] → [E_local, G·C_g, d] — each rank
-        # receives, for its local experts, every group's capacity slice
-        expert_in = lax.all_to_all(expert_in, expert_axis, 0, 1, tiled=True)
-        expert_out = _expert_ffn(expert_in, w1, w2, dtype)
-        # all-to-all #2 (inverse): [E_local, G·C_g, d] → [E, C_g, d] —
-        # this group's slots come home from every expert owner, experts
-        # back in global order (owners are rank-ordered)
-        expert_out = lax.all_to_all(expert_out, expert_axis, 1, 0, tiled=True)
-        out_g = jnp.einsum("tec,ecd->td", combine.astype(dtype), expert_out)
-        # reassemble the replicated [t, d] residual input: scatter+psum
-        # (the _gather_replicated idiom — statically replicated, unlike
-        # all_gather), fused with the TP row-parallel reduction
+        s_r = s // g_ep                   # this rank's contiguous slice
+        gh_l = gh // g_ep                 # its groups per row
+        x_r = lax.dynamic_slice_in_dim(x, me * s_r, s_r, axis=1)
+        n_g = b * gh_l
+        xg = x_r.reshape(n_g, gs, d)
+        dispatch, combine, frac, mprob = route_many(xg)
+        expert_in = jnp.einsum("gtec,gtd->gecd", dispatch.astype(dtype), xg)
+        # all-to-all #1: [n_g, E, cap, d] → [n_g, E_local, G·cap, d] —
+        # each rank receives, for its local experts, every rank's
+        # dispatched capacity slices
+        expert_in = lax.all_to_all(expert_in, expert_axis, 1, 2, tiled=True)
+        ei = (expert_in.transpose(1, 0, 2, 3)
+              .reshape(e_local, n_g * g_ep * cap, d))
+        eo = _expert_ffn(ei, w1, w2, dtype)
+        expert_out = (eo.reshape(e_local, n_g, g_ep * cap, d)
+                      .transpose(1, 0, 2, 3))
+        # all-to-all #2 (inverse): slots come home, experts back in
+        # global order (owners are rank-ordered)
+        expert_out = lax.all_to_all(expert_out, expert_axis, 2, 1,
+                                    tiled=True)
+        out_g = jnp.einsum("gtec,gecd->gtd", combine.astype(dtype),
+                           expert_out)
+        # reassemble the replicated [b, s, d] residual input:
+        # scatter+psum (the _gather_replicated idiom — statically
+        # replicated, unlike all_gather), fused with the TP reduction
         scat = lax.dynamic_update_slice_in_dim(
-            jnp.zeros((t, d), dtype), out_g, me * t_g, axis=0)
+            jnp.zeros((b, s, d), dtype), out_g.reshape(b, s_r, d),
+            me * s_r, axis=1)
         reduce_axes = ((expert_axis, tp_axis) if tp_axis is not None
                        else (expert_axis,))
         out = lax.psum(scat, reduce_axes)
 
-    stat_axes = ((() if expert_axis is None else (expert_axis,))
-                 + tuple(stats_axes))
-    if stat_axes:
-        # equal-sized groups ⇒ the mean of group means IS the global
-        # mean: aux computed from these equals the dense aux exactly
-        frac = lax.pmean(frac, stat_axes)
-        mprob = lax.pmean(mprob, stat_axes)
-    if return_stats:
-        return out.reshape(b, s, d), (frac, mprob)
-    aux = e * jnp.sum(frac * mprob)
-    return out.reshape(b, s, d), aux.astype(jnp.float32)
+    # per-group Switch loss, averaged over every group of the layer:
+    # mean over this call's groups, then over expert ranks (disjoint
+    # group slices) and seq shards — all equal-sized, so the pmean of
+    # means IS the global mean over groups
+    group_aux = e * jnp.sum(frac * mprob, axis=-1)        # [n_g]
+    aux = jnp.mean(group_aux)
+    reduce = ((() if expert_axis is None else (expert_axis,))
+              + tuple(stats_axes))
+    if reduce:
+        aux = lax.pmean(aux, reduce)
+    return out, aux.astype(jnp.float32)

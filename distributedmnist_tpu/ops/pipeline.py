@@ -263,12 +263,20 @@ def _index_pytree(tree, idx):
 def pipeline_1f1b_grads(chunk_fn: Callable, head_fn: Callable,
                         chunk_params, head_params,
                         microbatches: jax.Array, axis_name: str,
-                        num_chunks: int):
+                        num_chunks: int, with_aux: bool = False,
+                        aux_cotangent: float = 0.0):
     """Fused interleaved-1F1B training pipeline (inside shard_map).
 
     Args:
       chunk_fn: (slot_params, x) -> y, one virtual chunk of THIS device
-        (shape-preserving). Backward recomputes it via jax.vjp.
+        (shape-preserving). Backward recomputes it via jax.vjp. With
+        ``with_aux``, returns (y, aux) where ``aux`` is a scalar
+        auxiliary-loss contribution (e.g. the summed per-group MoE
+        load-balance aux of the chunk's layers) that enters the total
+        loss LINEARLY with weight ``aux_cotangent`` — linearity is what
+        lets the engine seed each backward chunk's aux output with the
+        constant cotangent instead of a value that depends on other
+        chunks.
       head_fn: (head_params, y, mb_index) -> (loss, metric) — the loss
         head applied to a LAST-chunk output microbatch (closes over
         labels; mb_index is a traced scalar). Differentiated w.r.t.
@@ -278,11 +286,18 @@ def pipeline_1f1b_grads(chunk_fn: Callable, head_fn: Callable,
       head_params: replicated loss-head params.
       microbatches: [M, mb, ...] pipeline inputs (already embedded).
       axis_name: the mesh stage axis.
+      aux_cotangent: the (already axis-normalized) weight the caller
+        gives each chunk-aux in the total loss; the backward seeds
+        every chunk's aux output with exactly this constant.
 
     Returns (losses [M], metrics [M], dinputs [M, mb, ...],
     dchunk_params (same layout as chunk_params, THIS device's grads),
     dhead_params (replicated — psum'd over the axis)); losses/metrics/
-    dinputs come out replicated over the axis.
+    dinputs come out replicated over the axis. With ``with_aux``, a
+    sixth element: the SUM over all (chunk, microbatch) forward works
+    of the chunk aux (psum'd over the axis — stages hold disjoint
+    chunks), i.e. Σ_layers aux summed over microbatches; the caller
+    scales by aux_cotangent/M for the loss value.
     """
     S = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
@@ -321,8 +336,15 @@ def pipeline_1f1b_grads(chunk_fn: Callable, head_fn: Callable,
                             chunk_params)
     zeros_dh = jax.tree.map(lambda p: vary(jnp.zeros_like(p)), head_params)
 
+    def run_chunk(slot_params, x):
+        """chunk_fn normalized to (y, aux): aux 0.0 when not with_aux,
+        so the branch structure is identical either way."""
+        if with_aux:
+            return chunk_fn(slot_params, x)
+        return chunk_fn(slot_params, x), jnp.zeros((), jnp.float32)
+
     def tick(carry, row):
-        X, Gin, dparams, dhead, losses, metrics, dinputs = carry
+        X, Gin, dparams, dhead, losses, metrics, dinputs, aux_acc = carry
         kind = row["kind"][me]
         j = row["slot"][me]
         m = row["mb"][me]
@@ -332,23 +354,26 @@ def pipeline_1f1b_grads(chunk_fn: Callable, head_fn: Callable,
         slot_params = _index_pytree(chunk_params, j)
 
         # Each branch returns (out_act, dy_seed, dslot_params,
-        # dhead_params, loss, metric): out_act is the forward output
-        # (F), the input-cotangent (B), or zeros (idle/seed handles dy
-        # separately so the seed's forward output never ships).
+        # dhead_params, loss, metric, aux): out_act is the forward
+        # output (F), the input-cotangent (B), or zeros (idle/seed
+        # handles dy separately so the seed's forward output never
+        # ships); aux is the chunk's auxiliary-loss value on forward
+        # works (zero elsewhere — the backward recompute would double-
+        # count it).
         zero_act = vary(jnp.zeros(mb_shape, dtype))
         zero_s = vary(jnp.zeros((), jnp.float32))
 
         def br_idle(_):
             return (zero_act, zero_act, zeros_dp, zeros_dh,
-                    zero_s, zero_s)
+                    zero_s, zero_s, zero_s)
 
         def br_fwd(_):
-            y = chunk_fn(slot_params, x)
+            y, aux = run_chunk(slot_params, x)
             return (vary(y.astype(dtype)), zero_act, zeros_dp, zeros_dh,
-                    zero_s, zero_s)
+                    zero_s, zero_s, vary(aux.astype(jnp.float32)))
 
         def br_seed(_):
-            y = chunk_fn(slot_params, x)
+            y, aux = run_chunk(slot_params, x)
             # differentiate w.r.t. a VARYING copy of the head params:
             # the transpose of invariant→varying would be a psum over
             # the axis — a collective inside one device's branch, which
@@ -362,17 +387,22 @@ def pipeline_1f1b_grads(chunk_fn: Callable, head_fn: Callable,
             dhp, dy = vjp(vary(jnp.ones((), jnp.float32)))
             dhp = jax.tree.map(vary, dhp)
             return (zero_act, dy.astype(dtype), zeros_dp, dhp,
-                    vary(loss), vary(metric))
+                    vary(loss), vary(metric),
+                    vary(aux.astype(jnp.float32)))
 
         def br_bwd(_):
-            _, vjp = jax.vjp(lambda sp, xx: chunk_fn(sp, xx),
-                             slot_params, x)
-            dsp, dx = vjp(g)
+            (y_p, aux_p), vjp = jax.vjp(
+                lambda sp, xx: run_chunk(sp, xx), slot_params, x)
+            # the aux enters the total loss linearly with weight
+            # aux_cotangent, so its cotangent is that CONSTANT — no
+            # cross-chunk value needed (arithmetic on aux_p keeps its
+            # varying-axes type)
+            dsp, dx = vjp((g, aux_p * 0.0 + aux_cotangent))
             dsp = jax.tree.map(vary, dsp)
             return (dx.astype(dtype), zero_act, dsp, zeros_dh,
-                    zero_s, zero_s)
+                    zero_s, zero_s, zero_s)
 
-        out_act, dy_seed, dsp, dhp, loss, metric = lax.switch(
+        out_act, dy_seed, dsp, dhp, loss, metric, aux = lax.switch(
             jnp.clip(kind, 0, 3), (br_idle, br_fwd, br_seed, br_bwd), None)
 
         is_f = kind == 1
@@ -385,6 +415,7 @@ def pipeline_1f1b_grads(chunk_fn: Callable, head_fn: Callable,
         dhead = jax.tree.map(lambda acc, d: acc + d, dhead, dhp)
         losses = losses.at[m].add(jnp.where(is_seed, loss, 0.0))
         metrics = metrics.at[m].add(jnp.where(is_seed, metric, 0.0))
+        aux_acc = aux_acc + jnp.where(is_f | is_seed, aux, 0.0)
         Gin = Gin.at[j, m].set(jnp.where(is_seed, dy_seed, Gin[j, m]))
         dinputs = dinputs.at[m].set(
             jnp.where(is_b & (bank == 1), out_act, dinputs[m]))
@@ -400,17 +431,21 @@ def pipeline_1f1b_grads(chunk_fn: Callable, head_fn: Callable,
         bi, bm = jnp.maximum(brs, 0), jnp.maximum(brm, 0)
         X = X.at[fi, fm].set(jnp.where(frs >= 0, f_in, X[fi, fm]))
         Gin = Gin.at[bi, bm].set(jnp.where(brs >= 0, b_in, Gin[bi, bm]))
-        return (X, Gin, dparams, dhead, losses, metrics, dinputs), None
+        return (X, Gin, dparams, dhead, losses, metrics, dinputs,
+                aux_acc), None
 
     rows = {k: jnp.asarray(tbl[k]) for k in
             ("kind", "slot", "mb", "bank", "frecv_slot", "frecv_mb",
              "brecv_slot", "brecv_mb")}
-    carry = (X0, Gin0, dparams0, dhead0, losses0, metrics0, dinputs0)
-    (X, Gin, dparams, dhead, losses, metrics, dinputs), _ = lax.scan(
+    aux0 = vary(jnp.zeros((), jnp.float32))
+    carry = (X0, Gin0, dparams0, dhead0, losses0, metrics0, dinputs0, aux0)
+    (X, Gin, dparams, dhead, losses, metrics, dinputs, aux_acc), _ = lax.scan(
         tick, carry, rows, length=T)
 
     # losses/metrics live on the last stage, dinputs on stage 0, dhead
-    # on the last stage — psum broadcasts each (zeros elsewhere)
+    # on the last stage — psum broadcasts each (zeros elsewhere); the
+    # aux accumulators cover each stage's own chunks (disjoint), so a
+    # plain psum totals the model
     last = (me == S - 1).astype(jnp.float32)
     first = (me == 0).astype(dtype)
     losses = lax.psum(losses * last, axis_name)
@@ -418,6 +453,9 @@ def pipeline_1f1b_grads(chunk_fn: Callable, head_fn: Callable,
     dinputs = lax.psum(dinputs * first, axis_name)
     dhead = jax.tree.map(
         lambda ddd: lax.psum(ddd * last.astype(ddd.dtype), axis_name), dhead)
+    if with_aux:
+        return (losses, metrics, dinputs, dparams, dhead,
+                lax.psum(aux_acc, axis_name))
     return losses, metrics, dinputs, dparams, dhead
 
 

@@ -230,15 +230,11 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         if pp_schedule == "1f1b":
             # fused interleaved schedule (ops/pipeline.py): explicit
             # forward/backward chunk-works in one scan — built below
-            # instead of value_and_grad. TP/SP collectives inside the
-            # chunk bodies execute inside the engine's stage-varying
-            # switch branches; that is safe because they reduce over
-            # NON-stage axes whose participant groups share a stage
-            # coordinate and hence a branch (ops/pipeline.py notes).
-            if n_expert > 1:
-                raise ValueError(
-                    "pipeline_schedule='1f1b' does not compose with "
-                    "expert parallelism yet (use 'gpipe')")
+            # instead of value_and_grad. TP/SP/EP collectives inside
+            # the chunk bodies execute inside the engine's
+            # stage-varying switch branches; that is safe because they
+            # reduce over NON-stage axes whose participant groups share
+            # a stage coordinate and hence a branch (ops/pipeline.py).
             if getattr(model, "pp_1f1b_grads_factory", None) is None:
                 raise ValueError(f"model {model.name!r} has no 1f1b "
                                  "pipeline support")
@@ -298,7 +294,7 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
 
     def local_loss_pp(params, batch, dropout_key):
         del dropout_key
-        if has_aux:  # MoE through the pipeline: batch-mean-stats aux
+        if has_aux:  # MoE: per-group aux, tick-accumulated (apply_pp)
             logits, aux = pp_apply(params, batch["image"], return_aux=True)
             return model.loss(logits, batch["label"]) + aux_w * aux, logits
         logits = pp_apply(params, batch["image"])  # stage-replicated
@@ -335,18 +331,17 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
             nxt = lax.ppermute(labels[:, :1], seq_ax, perm)
             tgt = jnp.concatenate([labels[:, 1:], nxt], axis=1).astype(jnp.int32)
 
+            from ..models.transformer import sp_partial_token_loss
             s_global = s_loc * n_seq
-            w = (positions < s_global - 1).astype(jnp.float32)[None, :]
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-            correct = (jnp.argmax(logp, axis=-1) == tgt).astype(jnp.float32)
-            total = b * (s_global - 1)  # this replica's global token count
+            # total = this replica's global token count; the shared
+            # kernel keeps this path and the 1F1B seed head identical
+            loss_part, acc_part = sp_partial_token_loss(
+                logits, tgt, positions, s_global, b * (s_global - 1))
             # aux is already the full-token value on every seq shard
             # (moe_ffn pmeans its stats over the stats_axes), so the
             # caller's psum over the seq axis would count it n_seq
             # times — pre-divide so the psum reassembles exactly one.
-            return (jnp.sum(nll * w) / total + aux_w * aux / n_seq,
-                    jnp.sum(correct * w) / total)
+            return loss_part + aux_w * aux / n_seq, acc_part
         return sp_loss
 
     local_loss_sp = (make_sp_loss(sharded_apply, has_aux)
@@ -564,11 +559,6 @@ def build_eval_step(model: Model, cfg: ExperimentConfig, topo: Topology):
         ep_ax = topo.expert_axis if n_expert > 1 else None
         pspec: Any = model.pp_param_specs(topo.stage_axis, tp_ax, ep_ax)
         if (cfg.mesh.pipeline_schedule == "1f1b"
-                and n_expert > 1):  # same as training
-            raise ValueError(
-                "pipeline_schedule='1f1b' does not compose with "
-                "expert parallelism yet (use 'gpipe')")
-        if (cfg.mesh.pipeline_schedule == "1f1b"
                 and getattr(model, "pp_1f1b_apply_factory", None) is None):
             # mirror the train-path guard: fail with a clear error at
             # build time instead of an opaque trace-time NoneType call
@@ -580,19 +570,16 @@ def build_eval_step(model: Model, cfg: ExperimentConfig, topo: Topology):
             # per-replica rows are static at trace time (eval batches
             # are padded to a fixed shape); pipeline at the largest
             # microbatch count ≤ the training cadence that divides
-            # them. EXCEPT MoE: expert capacity is token-group-local,
-            # so the microbatch split would change which tokens group
-            # together and eval metrics would vary with the divisor of
-            # the batch size — MoE evaluates at M=1 (one full-batch
-            # grouping, the dense oracle's own), trading the pipeline
-            # overlap for metric stability.
+            # them. MoE included: token groups nest inside sequence
+            # rows (ops/moe.py), so routing capacity and metrics are
+            # identical for every microbatch split — the round-4 M=1
+            # force is gone (tests pin M-invariance).
             b = images.shape[0]
-            m_eval = (1 if getattr(model, "has_aux", False) else
-                      max(m for m in range(1, cap + 1) if b % m == 0))
+            m_eval = max(m for m in range(1, cap + 1) if b % m == 0)
             if cfg.mesh.pipeline_schedule == "1f1b":
                 apply_fn = model.pp_1f1b_apply_factory(
                     topo.stage_axis, m_eval, cfg.mesh.pipeline_chunks,
-                    tp_ax)
+                    tp_ax, ep_ax)
             else:
                 apply_fn = model.pp_apply_factory(topo.stage_axis, m_eval,
                                                   tp_ax, None, ep_ax)

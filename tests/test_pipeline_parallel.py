@@ -341,20 +341,6 @@ def test_1f1b_sp_step_matches_dense_update(n_replicas, n_stage, n_seq,
                                    rtol=3e-4, atol=3e-5)
 
 
-def test_1f1b_refuses_ep():
-    """Expert parallelism is the matrix's one remaining 1f1b gap (the
-    fused engine does not accumulate routing statistics yet)."""
-    cfg = _cfg().override({"model.num_experts": 4,
-                           "mesh.num_replicas": 1,
-                           "mesh.pipeline_parallelism": 2,
-                           "mesh.expert_parallelism": 2,
-                           "mesh.pipeline_schedule": "1f1b",
-                           "mesh.pipeline_chunks": 2})
-    with pytest.raises(ValueError, match="1f1b|expert"):
-        build_train_step(get_model(cfg.model), cfg, make_topology(cfg.mesh),
-                         constant(LR))
-
-
 def test_1f1b_sp_refuses_ring_attention():
     """Ring attention's ppermute rendezvouses globally — inside the
     fused engine's stage-varying branches it would deadlock, so the
