@@ -56,17 +56,30 @@ class Evaluator:
             # configs — no forced N-device backend, no collectives, no
             # rendezvous to starve while sharing a host with the
             # trainer; the campaign's live oracle runs this way).
-            # Params of a data-parallel run are replicated, so the
-            # restore is shape-identical; model-sharded layouts are not
-            # reconstructible on one device — refuse those.
+            # DP checkpoints restore shape-identically (replicated);
+            # TP/SP/EP checkpoints restore too — their global arrays
+            # equal the unsharded init layout, and the per-host sharded
+            # format reassembles full arrays on read
+            # (train/checkpoint.py). Only pipeline layouts genuinely
+            # differ (layer-stacked/chunk-interleaved blocks vs the
+            # flat list) — refuse those; the default full-mesh
+            # evaluator handles them.
             m = cfg.mesh
-            if (m.model_parallelism > 1 or m.seq_parallelism > 1
-                    or m.pipeline_parallelism > 1
-                    or m.expert_parallelism > 1):
+            if m.pipeline_parallelism > 1:
                 raise ValueError(
-                    "single_device evaluation supports data-parallel "
-                    "checkpoints only (params replicated); this run has "
-                    "model/seq/stage/expert parallelism")
+                    "single_device evaluation cannot restore "
+                    "pipeline-stacked parameter layouts; run the "
+                    "evaluator without --single_device (it builds the "
+                    "training mesh)")
+            if (cfg.model.num_experts > 0 and cfg.model.moe_num_groups == 0
+                    and (m.expert_parallelism > 1 or m.seq_parallelism > 1)):
+                raise ValueError(
+                    "single_device evaluation of an expert-/seq-sharded "
+                    "MoE run needs an explicit model.moe_num_groups: with "
+                    "the mesh-derived auto grouping the 1-device routing "
+                    "(groups/capacity) differs from the training mesh and "
+                    "metrics would silently diverge; set moe_num_groups "
+                    "or run the evaluator without --single_device")
             self.topo = make_topology(MeshConfig(num_replicas=1),
                                       devices=jax.devices()[:1])
         else:

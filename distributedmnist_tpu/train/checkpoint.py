@@ -12,18 +12,32 @@ and the evaluator's read side (src/nn_eval.py:70-88). Differences:
   time-seeded data stream from scratch).
 * A ``checkpoint.json`` pointer names the latest step — the moral
   equivalent of TF's ``checkpoint`` proto file.
+* **Per-host sharded format** (SURVEY §2.3 "per-host array
+  serialization", ≙ the Saver-over-NFS multi-worker layout): when the
+  state holds arrays whose shards this process cannot fully
+  materialize (a model/seq/stage/expert axis crossing process
+  boundaries), EVERY process writes
+  ``ckpt-{step}.shard{p}-of-{P}.msgpack`` with its addressable shard
+  data keyed by global index, and process 0 writes a
+  ``ckpt-{step}.manifest.json`` (global shapes/dtypes + the extra
+  payload) plus the pointer. Restore reads every shard file and
+  reassembles full global arrays — so any layout-compatible consumer
+  (a resumed cluster of any process count, the evaluator on its own
+  mesh, a single device) can load the checkpoint.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
 from pathlib import Path
 from typing import Any
 
 import jax
+import numpy as np
 from flax import serialization
 
 from ..core.log import get_logger
@@ -37,29 +51,128 @@ def _ckpt_path(train_dir: Path, step: int) -> Path:
     return train_dir / f"ckpt-{step:08d}.msgpack"
 
 
-def save_checkpoint(train_dir: str | Path, state: Any, step: int,
-                    extra: dict | None = None, keep: int = 5) -> Path:
-    """Atomically write state (+ JSON-serializable ``extra``) at ``step``."""
-    train_dir = Path(train_dir)
-    train_dir.mkdir(parents=True, exist_ok=True)
-    state = jax.device_get(state)
-    # extra goes through JSON (tuples etc. are not msgpack-clean)
-    payload = {"state": serialization.to_state_dict(state),
-               "extra": json.dumps(extra or {})}
-    data = serialization.msgpack_serialize(payload)
-    path = _ckpt_path(train_dir, step)
-    tmp = path.with_suffix(".tmp")
+def _manifest_path(train_dir: Path, step: int) -> Path:
+    return train_dir / f"ckpt-{step:08d}.manifest.json"
+
+
+def _shard_path(train_dir: Path, step: int, p: int, count: int) -> Path:
+    return train_dir / f"ckpt-{step:08d}.shard{p:03d}-of-{count:03d}.msgpack"
+
+
+def _leaf_locally_complete(leaf: Any) -> bool:
+    """True when this process can materialize the WHOLE array."""
+    if not isinstance(leaf, jax.Array):
+        return True
+    return bool(leaf.is_fully_addressable or leaf.is_fully_replicated)
+
+
+def state_needs_sharded_save(state: Any) -> bool:
+    """True when some array's shards live only on other processes —
+    the single-file writer (a process-0 ``device_get``) cannot
+    materialize it and the per-host sharded format must be used."""
+    return not all(_leaf_locally_complete(l) for l in jax.tree.leaves(state))
+
+
+def _flat_state_items(state: Any):
+    """state → [("a/b/c", leaf)] over the flax state-dict view."""
+    sd = serialization.to_state_dict(state)
+    flat, _ = jax.tree_util.tree_flatten_with_path(sd)
+    out = []
+    for path, leaf in flat:
+        key = "/".join(str(getattr(k, "key", k)) for k in path)
+        out.append((key, leaf))
+    return out
+
+
+def snapshot_for_save(state: Any):
+    """Synchronously pull this process's view of ``state`` to host.
+
+    Returns ``("full", host_state_dict)`` when every leaf is locally
+    complete (the classic single-file layout, written by process 0), or
+    ``("sharded", local_leaves, meta)`` where ``local_leaves`` maps
+    leaf keys to either a full ndarray (locally-complete leaves, kept
+    by process 0 only) or ``{"indices": [...], "datas": [...]}`` shard
+    slabs, and ``meta`` records global shape/dtype per leaf.
+    """
+    if not state_needs_sharded_save(state):
+        return ("full", serialization.to_state_dict(jax.device_get(state)))
+    pidx = jax.process_index()
+    local: dict = {}
+    meta: dict = {}
+    for key, leaf in _flat_state_items(state):
+        if leaf is None:
+            continue
+        if _leaf_locally_complete(leaf):
+            meta[key] = {"full": True}
+            if pidx == 0:
+                local[key] = np.asarray(jax.device_get(leaf))
+            continue
+        meta[key] = {"shape": list(leaf.shape), "dtype": str(leaf.dtype)}
+        slabs: dict = {}
+        for sh in leaf.addressable_shards:
+            idx = tuple(sl.indices(dim)[:2]
+                        for sl, dim in zip(sh.index, leaf.shape))
+            if idx not in slabs:  # replicas of the same slab: keep one
+                slabs[idx] = np.asarray(sh.data)
+        local[key] = {
+            "indices": [[list(ab) for ab in idx] for idx in slabs],
+            "datas": list(slabs.values()),
+        }
+    return ("sharded", local, meta)
+
+
+def _write_atomic(path: Path, data: bytes) -> None:
+    tmp = path.with_suffix(path.suffix + ".tmp")
     tmp.write_bytes(data)
     os.replace(tmp, path)
 
-    pointer = {"latest_step": step, "latest_path": path.name,
+
+def _write_pointer(train_dir: Path, step: int, latest_name: str) -> None:
+    pointer = {"latest_step": step, "latest_path": latest_name,
                "written_at": time.time()}
     ptmp = train_dir / (_POINTER + ".tmp")
     ptmp.write_text(json.dumps(pointer))
     os.replace(ptmp, train_dir / _POINTER)
 
+
+def save_checkpoint(train_dir: str | Path, state: Any, step: int,
+                    extra: dict | None = None, keep: int = 5) -> Path:
+    """Atomically write state (+ JSON-serializable ``extra``) at
+    ``step``. ``state`` may be a live (possibly device-sharded) pytree
+    or a snapshot from :func:`snapshot_for_save` (the async writer's
+    path). Single-file when this process can materialize everything;
+    per-host sharded otherwise (module docstring) — in the sharded case
+    EVERY process must call this (each writes its own shard file)."""
+    train_dir = Path(train_dir)
+    train_dir.mkdir(parents=True, exist_ok=True)
+    snap = (state if isinstance(state, tuple)
+            and state and state[0] in ("full", "sharded")
+            else snapshot_for_save(state))
+
+    if snap[0] == "full":
+        # extra goes through JSON (tuples etc. are not msgpack-clean)
+        payload = {"state": snap[1], "extra": json.dumps(extra or {})}
+        data = serialization.msgpack_serialize(payload)
+        path = _ckpt_path(train_dir, step)
+        _write_atomic(path, data)
+        _write_pointer(train_dir, step, path.name)
+        _garbage_collect(train_dir, keep)
+        logger.info("saved checkpoint step=%d → %s", step, path.name)
+        return path
+
+    _, local, meta = snap
+    pidx, pcount = jax.process_index(), jax.process_count()
+    path = _shard_path(train_dir, step, pidx, pcount)
+    _write_atomic(path, serialization.msgpack_serialize({"leaves": local}))
+    if pidx == 0:
+        manifest = {"step": step, "num_shards": pcount, "leaves": meta,
+                    "extra": extra or {}}
+        mpath = _manifest_path(train_dir, step)
+        _write_atomic(mpath, json.dumps(manifest).encode())
+        _write_pointer(train_dir, step, mpath.name)
+        logger.info("saved sharded checkpoint step=%d → %s (+%d shard files)",
+                    step, mpath.name, pcount)
     _garbage_collect(train_dir, keep)
-    logger.info("saved checkpoint step=%d → %s", step, path.name)
     return path
 
 
@@ -144,7 +257,9 @@ class AsyncCheckpointer:
                     f"{self._consecutive_failures} consecutive async "
                     "checkpoint writes failed; giving up"
                 ) from self._last_failure
-        host_state = jax.device_get(state)  # sync: buffers get donated next step
+        # sync snapshot: buffers get donated next step (sharded states
+        # snapshot their addressable shards the same way)
+        host_state = snapshot_for_save(state)
         with self._wake:
             if self.closed:
                 raise RuntimeError("AsyncCheckpointer is closed")
@@ -170,15 +285,31 @@ class AsyncCheckpointer:
         self._thread.join(timeout=60)
 
 
+_STEP_RE = re.compile(r"^ckpt-(\d+)")
+
+
+def _ckpt_step_of(name: str) -> int | None:
+    m = _STEP_RE.match(name)
+    return int(m.group(1)) if m else None
+
+
 def _garbage_collect(train_dir: Path, keep: int) -> None:
+    """Keep the last ``keep`` STEPS — single files, shard files, and
+    manifests all group by their step prefix. Every process of a
+    sharded run GCs (concurrent unlinks race benignly)."""
     if keep <= 0:
         return
-    ckpts = sorted(train_dir.glob("ckpt-*.msgpack"))
-    for old in ckpts[:-keep]:
-        try:
-            old.unlink()
-        except OSError:
-            pass
+    by_step: dict[int, list[Path]] = {}
+    for f in train_dir.glob("ckpt-*"):
+        s = _ckpt_step_of(f.name)
+        if s is not None and not f.name.endswith(".tmp"):
+            by_step.setdefault(s, []).append(f)
+    for s in sorted(by_step)[:-keep]:
+        for old in by_step[s]:
+            try:
+                old.unlink()
+            except OSError:
+                pass
 
 
 def latest_checkpoint_step(train_dir: str | Path) -> int | None:
@@ -194,10 +325,24 @@ def latest_checkpoint_step(train_dir: str | Path) -> int | None:
                 return int(d["latest_step"])
         except (json.JSONDecodeError, KeyError, ValueError):
             pass
-    ckpts = sorted(train_dir.glob("ckpt-*.msgpack"))
-    if not ckpts:
+    steps = _loadable_steps(train_dir)
+    if not steps:
         return None
-    return int(ckpts[-1].stem.split("-")[1])
+    return max(steps)
+
+
+def _loadable_steps(train_dir: Path) -> list[int]:
+    """Steps that can actually be restored: a single-file .msgpack or a
+    manifest (shard files alone — a crash mid-publish — don't count)."""
+    steps = set()
+    for f in train_dir.glob("ckpt-*"):
+        s = _ckpt_step_of(f.name)
+        if s is None or f.name.endswith(".tmp"):
+            continue
+        if f.name.endswith(".manifest.json") or f.name == _ckpt_path(
+                train_dir, s).name:
+            steps.add(s)
+    return sorted(steps)
 
 
 def read_checkpoint_extra(train_dir: str | Path,
@@ -211,6 +356,9 @@ def read_checkpoint_extra(train_dir: str | Path,
         step = latest_checkpoint_step(train_dir)
         if step is None:
             return None
+    mpath = _manifest_path(train_dir, step)
+    if mpath.exists():
+        return json.loads(mpath.read_text()).get("extra", {}), step
     payload = serialization.msgpack_restore(_ckpt_path(train_dir, step).read_bytes())
     extra = payload.get("extra", {})
     if isinstance(extra, (str, bytes)):
@@ -218,15 +366,86 @@ def read_checkpoint_extra(train_dir: str | Path,
     return extra, step
 
 
+def _restore_sharded(train_dir: Path, template_state: Any,
+                     step: int) -> tuple[Any, dict, int]:
+    """Reassemble full global arrays from every process's shard file
+    (readable by ANY process count — the evaluator or a resumed
+    cluster of a different size reads the same files)."""
+    manifest = json.loads(_manifest_path(train_dir, step).read_text())
+    pcount = int(manifest["num_shards"])
+    meta = manifest["leaves"]
+    leaves: dict[str, np.ndarray] = {}
+    for p in range(pcount):
+        payload = serialization.msgpack_restore(
+            _shard_path(train_dir, step, p, pcount).read_bytes())
+        for key, val in payload["leaves"].items():
+            if isinstance(val, dict) and "indices" in val:
+                m = meta[key]
+                buf = leaves.setdefault(
+                    key, np.empty(tuple(m["shape"]), np.dtype(m["dtype"])))
+                for idx, data in zip(val["indices"], val["datas"]):
+                    buf[tuple(slice(a, b) for a, b in idx)] = data
+            elif key not in leaves:  # locally-complete leaf (first wins)
+                leaves[key] = np.asarray(val)
+    nested: dict = {}
+    for key, arr in leaves.items():
+        node = nested
+        parts = key.split("/")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = arr
+
+    # None fields (momentum off, non-interval mode) have no leaves, so
+    # the flattened files carry no entry — graft them back from the
+    # template so from_state_dict sees every field (a missing non-None
+    # leaf stays a loud KeyError: that's real corruption)
+    def graft_nones(sub: Any, tmpl: Any) -> Any:
+        if tmpl is None:
+            return None
+        if isinstance(tmpl, dict):
+            got = sub if isinstance(sub, dict) else {}
+            return {k: (None if tv is None
+                        else graft_nones(got.get(k, {}), tv)
+                        if isinstance(tv, dict) else got[k])
+                    for k, tv in tmpl.items()}
+        return sub
+
+    nested = graft_nones(nested, serialization.to_state_dict(template_state))
+    state = serialization.from_state_dict(template_state, nested)
+    return state, manifest.get("extra", {}), step
+
+
 def restore_checkpoint(train_dir: str | Path, template_state: Any,
                        step: int | None = None) -> tuple[Any, dict, int] | None:
     """Restore (state, extra, step); None when nothing exists
-    (≙ Supervisor's restore-if-present, src/distributed_train.py:262)."""
+    (≙ Supervisor's restore-if-present, src/distributed_train.py:262).
+    Handles both the single-file and the per-host sharded layouts.
+
+    When no explicit ``step`` is given, a torn latest checkpoint (a
+    sharded publish interrupted between process 0's manifest and a
+    sibling's shard file — there is no cross-process barrier in the
+    async writer) falls back to the next older complete step instead of
+    wedging the resume forever."""
     train_dir = Path(train_dir)
-    if step is None:
-        step = latest_checkpoint_step(train_dir)
-        if step is None:
-            return None
+    if step is not None:
+        return _restore_step(train_dir, template_state, step)
+    candidates = _loadable_steps(train_dir)
+    latest = latest_checkpoint_step(train_dir)
+    if latest is not None and latest not in candidates:
+        candidates.append(latest)
+    for s in sorted(set(candidates), reverse=True):
+        try:
+            return _restore_step(train_dir, template_state, s)
+        except FileNotFoundError as e:
+            logger.warning("checkpoint step=%d is incomplete (%s); "
+                           "falling back to an older step", s, e)
+    return None
+
+
+def _restore_step(train_dir: Path, template_state: Any,
+                  step: int) -> tuple[Any, dict, int]:
+    if _manifest_path(train_dir, step).exists():
+        return _restore_sharded(train_dir, template_state, step)
     path = _ckpt_path(train_dir, step)
     payload = serialization.msgpack_restore(path.read_bytes())
     saved = payload["state"]

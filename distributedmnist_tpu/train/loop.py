@@ -98,7 +98,8 @@ class Trainer:
         self.delay_injection_ms: np.ndarray | None = None
         self.is_writer = jax.process_index() == 0
         self.train_dir = Path(cfg.train.train_dir)
-        self._use_async_ckpt = cfg.train.async_checkpoint and self.is_writer
+        self._use_async_ckpt = cfg.train.async_checkpoint and (
+            self.is_writer or ckpt.state_needs_sharded_save(self.state))
         self._checkpointer: ckpt.AsyncCheckpointer | None = None
         self._sink: JsonlSink | None = None
         # TB scalars on the summary cadence (≙ chief summary writes,
@@ -149,7 +150,12 @@ class Trainer:
                     step, self._start_step)
 
     def _save(self, step: int) -> None:
-        if not self.is_writer:
+        # Sharded layouts (a model/seq/stage/expert axis crossing
+        # process boundaries): EVERY process writes its shard file;
+        # process 0 additionally writes the manifest + pointer
+        # (train/checkpoint.py per-host format). Otherwise process 0
+        # writes the classic single file alone.
+        if not self.is_writer and not ckpt.state_needs_sharded_save(self.state):
             return
         extra = {"config": self.cfg.to_dict()}
         iter_state = getattr(self.train_iter, "state", None)
