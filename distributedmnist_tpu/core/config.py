@@ -156,6 +156,16 @@ class SyncConfig:
     straggler_sigma: float = 0.5
     straggler_spike_prob: float = 0.05
     straggler_spike_scale: float = 10.0
+    # Per-replica DEVICE-side timing (obsv/timing.py:ReplicaDeviceProbe):
+    # each local replica's device is probed with a trivial op enqueued
+    # behind everything on its queue, and the measured drain SKEW joins
+    # the per-host measured step time in the [n] vector the policies
+    # rank on. Within one lockstep SPMD program replicas cannot diverge
+    # (collectives barrier them), so the skew captures work queued
+    # OUTSIDE the shared program — per-device callbacks, injected chaos
+    # work, asymmetric host feeds. Off by default (one probe dispatch +
+    # readiness poll per local replica per step).
+    measure_device_skew: bool = False
 
 
 @dataclass(frozen=True)

@@ -83,6 +83,118 @@ def _report(args) -> None:
     print(json.dumps(stats, indent=2))
 
 
+def _fetch(args) -> None:
+    """Download + verify the REAL archives (≙ maybe_download,
+    src/mnist_data.py:176-187, plus the digest pinning the reference
+    never had). The one-command path from a fixture cache to verified
+    real data: the day this box has egress,
+    ``launch fetch --verify`` upgrades the cache and rewrites
+    PROVENANCE.md to say so — the 99%-on-real-MNIST oracle is then
+    ``launch train --config configs/repro/mnist_99.json`` away."""
+    import hashlib
+    import time
+    from pathlib import Path
+
+    from ..data import datasets as DS
+
+    root = Path(args.data_dir)
+    dataset = args.dataset
+    pins = DS._PINNED_SHA256.get(dataset, {})
+    plan = []
+    for key, names in DS._IDX_FILES.items():
+        gz = names[0] + ".gz"
+        cached = DS._find_idx(root, names)
+        status = "missing"
+        if cached is not None:
+            if cached.name in pins:
+                got = hashlib.sha256(cached.read_bytes()).hexdigest()
+                status = ("verified" if got == pins[cached.name]
+                          else "DIGEST MISMATCH")
+            else:
+                status = "cached, not digest-verifiable (fixture or raw idx)"
+        plan.append({"file": gz, "cached": str(cached) if cached else None,
+                     "status": status, "pinned_sha256": pins.get(gz),
+                     "mirrors": [b + gz for b in DS._IDX_MIRRORS[dataset]]})
+
+    if args.dry_run:
+        print(json.dumps({"dataset": dataset, "data_dir": str(root),
+                          "plan": plan}, indent=2))
+        return
+
+    quarantined: list[tuple] = []
+    if args.verify:
+        # anything cached that cannot be digest-verified (the synthetic
+        # fixture, an unpinned raw idx, a mismatch) steps ASIDE so the
+        # download below replaces it with the verifiable archive — but
+        # only a successful download deletes it: without egress the
+        # fixture cache must survive intact
+        for entry, (key, names) in zip(plan, DS._IDX_FILES.items()):
+            if entry["cached"] and entry["status"] != "verified":
+                for name in names:
+                    for cand in (root / name, root / (name + ".gz")):
+                        if cand.exists():
+                            aside = cand.with_name(cand.name + ".quarantine")
+                            cand.rename(aside)
+                            quarantined.append((aside, cand))
+
+    ok = DS.maybe_download(root, dataset)
+    verified = {}
+    unverifiable = []
+    for key, names in DS._IDX_FILES.items():
+        cached = DS._find_idx(root, names)
+        if cached is None:
+            ok = False
+            continue
+        if cached.name in pins:
+            got = hashlib.sha256(cached.read_bytes()).hexdigest()
+            if got != pins[cached.name]:
+                ok = False
+                continue
+            verified[cached.name] = got
+        else:
+            # a legitimate cache of uncompressed idx files (or an
+            # unpinned dataset): structurally validated on install,
+            # just not digest-pinnable — present counts as healthy
+            unverifiable.append(cached.name)
+
+    if ok:
+        for aside, _orig in quarantined:
+            aside.unlink(missing_ok=True)
+    else:
+        # transactional restore: drop any partially-downloaded
+        # replacement whose fixture was quarantined, then put every
+        # quarantined file back — the cache ends EXACTLY as it started
+        for aside, orig in quarantined:
+            orig.unlink(missing_ok=True)
+            aside.rename(orig)
+
+    if ok:
+        (root / "PROVENANCE.md").write_text(
+            f"# Real dataset ({dataset})\n\n"
+            f"Downloaded and installed by `launch fetch` at "
+            f"{time.strftime('%Y-%m-%d %H:%M:%S UTC', time.gmtime())}.\n"
+            + ("Archives verified against the pinned sha256 digests "
+               "(distributedmnist_tpu/data/datasets.py:_PINNED_SHA256):\n\n"
+               + "".join(f"- `{k}`: `{v}`\n" for k, v in sorted(verified.items()))
+               if verified else
+               "No digest-pinnable archives (structural idx validation "
+               "applied on install).\n")
+            + ("".join(f"- `{n}`: present, structurally valid, no digest "
+                       "pin applicable\n" for n in sorted(unverifiable))
+               if unverifiable else ""))
+        print(json.dumps({"ok": True, "dataset": dataset,
+                          "data_dir": str(root),
+                          "verified": sorted(verified),
+                          "unverifiable": sorted(unverifiable)}))
+    else:
+        print(json.dumps({"ok": False, "dataset": dataset,
+                          "data_dir": str(root),
+                          "hint": "no egress or mirror/digest failure; "
+                                  "the cache was left as-is (fixture runs "
+                                  "keep working)"}))
+        sys.exit(1)
+
+
 def _devices(_args) -> None:
     """≙ list_running_instances (tools/tf_ec2.py:371-402) — but the
     'cluster' is whatever mesh JAX sees."""
@@ -142,6 +254,22 @@ def main(argv=None) -> None:
 
     pd = sub.add_parser("devices", help="show mesh topology")
     pd.set_defaults(fn=_devices)
+
+    pf = sub.add_parser(
+        "fetch", help="download + digest-verify the real dataset archives "
+                      "(one command from fixture cache to verified real "
+                      "data, ≙ src/mnist_data.py:39,179)")
+    pf.add_argument("--dataset", default="mnist",
+                    choices=["mnist", "fashion_mnist"])
+    pf.add_argument("--data-dir", default="data_cache/mnist")
+    pf.add_argument("--verify", action="store_true",
+                    help="re-verify cached archives against the pinned "
+                         "sha256 digests; non-verifiable cached files "
+                         "(e.g. the synthetic fixture) are replaced")
+    pf.add_argument("--dry-run", action="store_true",
+                    help="print the fetch/verify plan without touching "
+                         "the network or the cache")
+    pf.set_defaults(fn=_fetch)
 
     def _pod(args) -> None:
         from .pod import main as pod_main

@@ -19,6 +19,7 @@ points, so the device pipeline is never synced per step (SURVEY §7
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any
 
 import numpy as np
@@ -114,3 +115,55 @@ class StepTimeCollector:
         self._raw.clear()
         self._materialized = 0
         self._host_steps.clear()
+
+
+class ReplicaDeviceProbe:
+    """Per-replica DEVICE-side completion probes.
+
+    One representative device per LOCAL replica is probed each step
+    with a trivial jitted op on a device-resident token: per-device
+    execution is FIFO, so the probe completes only once everything
+    queued on that device — the train step's program slice plus any
+    work dispatched after it (injected chaos programs, per-device
+    callbacks) — has drained. Readiness is POLLED (not serially
+    blocked) so each device gets its own completion timestamp.
+
+    The lockstep SPMD step itself cannot produce skew (its collectives
+    barrier the devices); what this measures is precisely the
+    per-device work OUTSIDE the shared program — the part a per-host
+    wall clock is blind to. ≙ the per-worker measured times the
+    reference gossips (src/timeout_manager.py:48-61), at per-DEVICE
+    granularity on one host.
+    """
+
+    def __init__(self, topo) -> None:
+        import jax
+        me = jax.process_index()
+        n = topo.num_replicas
+        grid = topo.mesh.devices.reshape(n, -1)
+        self.devices: list = []   # (replica_index, device), local only
+        for r in range(n):
+            local = [d for d in grid[r] if d.process_index == me]
+            if local:
+                self.devices.append((r, local[0]))
+        self._tokens = [jax.device_put(np.float32(0), d)
+                        for _, d in self.devices]
+        self._inc = jax.jit(lambda x: x + 1.0)
+
+    def measure_skew_ms(self) -> np.ndarray:
+        """Dispatch one probe per local replica device and poll their
+        completions; returns per-local-replica drain skew in ms
+        (min-subtracted, so a lockstep step reads ~zero)."""
+        import jax  # noqa: F401  (tokens/jit already bound)
+        outs = [self._inc(t) for t in self._tokens]
+        t0 = time.perf_counter()
+        times = np.zeros(len(outs), np.float64)
+        pending = set(range(len(outs)))
+        while pending:
+            for i in list(pending):
+                if outs[i].is_ready():
+                    times[i] = (time.perf_counter() - t0) * 1000.0
+                    pending.discard(i)
+            if pending:
+                time.sleep(0.0002)
+        return (times - times.min()).astype(np.float32)

@@ -243,14 +243,20 @@ class AsyncCheckpointer:
             raise RuntimeError("async checkpoint write failed") from err
 
     def save(self, train_dir: str | Path, state: Any, step: int,
-             extra: dict | None = None, keep: int = 5) -> None:
+             extra: dict | None = None, keep: int = 5,
+             no_skip: bool = False) -> None:
         """Queue a write. A single failed write never raises here —
         that already went to the log and a later save may well succeed
         (transient disk pressure); ``wait`` raises if the LAST write
         failed, so a broken final checkpoint is never silent. A
         persistently broken disk does stop training: after
         ``max_consecutive_failures`` failed writes in a row, ``save``
-        raises instead of letting checkpoints go silently stale."""
+        raises instead of letting checkpoints go silently stale.
+
+        ``no_skip``: drain a lagging queued write instead of replacing
+        it — the per-host sharded layout needs EVERY process to write
+        EVERY triggered step, or a process that skipped a different
+        step than its siblings would leave that checkpoint torn."""
         with self._lock:
             if self._consecutive_failures >= self.max_consecutive_failures:
                 raise RuntimeError(
@@ -260,6 +266,10 @@ class AsyncCheckpointer:
         # sync snapshot: buffers get donated next step (sharded states
         # snapshot their addressable shards the same way)
         host_state = snapshot_for_save(state)
+        if no_skip:
+            with self._wake:
+                while self._pending is not None and not self.closed:
+                    self._wake.wait()
         with self._wake:
             if self.closed:
                 raise RuntimeError("AsyncCheckpointer is closed")

@@ -96,10 +96,37 @@ class Trainer:
         # added onto the measured vector — lets tests (and chaos runs)
         # make a specific replica the straggler deterministically.
         self.delay_injection_ms: np.ndarray | None = None
+        # Per-replica DEVICE-side timing (sync.measure_device_skew):
+        # the probe measures each local replica device's queue-drain
+        # skew each step; it joins the measured [n] vector so the
+        # policies rank on genuinely per-DEVICE time, not one host dt
+        # per process (obsv/timing.py:ReplicaDeviceProbe).
+        self._device_probe = None
+        self._last_device_skew: np.ndarray | None = None
+        if (cfg.sync.measure_device_skew
+                and self.topo.measured_timing_supported):
+            from ..obsv.timing import ReplicaDeviceProbe
+            self._device_probe = ReplicaDeviceProbe(self.topo)
+        # Chaos seam for REAL device-side delay (not a config constant):
+        # {local_replica_index: (jitted_fn, device_resident_arg)} —
+        # dispatched async right after each step so the named replica's
+        # device genuinely drains later; the probe observes it.
+        self.device_work_injection: dict[int, tuple] | None = None
         self.is_writer = jax.process_index() == 0
         self.train_dir = Path(cfg.train.train_dir)
+        self._sharded_ckpt = ckpt.state_needs_sharded_save(self.state)
         self._use_async_ckpt = cfg.train.async_checkpoint and (
-            self.is_writer or ckpt.state_needs_sharded_save(self.state))
+            self.is_writer or self._sharded_ckpt)
+        if (self._sharded_ckpt and cfg.train.save_interval_secs > 0
+                and jax.process_count() > 1):
+            # every process must write its shard for the SAME steps;
+            # per-process wall clocks cannot agree on a seconds-based
+            # trigger, so each periodic checkpoint would be torn
+            # (shard files at different steps, no complete set)
+            raise ValueError(
+                "a cross-process sharded layout needs a deterministic "
+                "checkpoint cadence every process agrees on: set "
+                "train.save_interval_steps (and save_interval_secs=0)")
         self._checkpointer: ckpt.AsyncCheckpointer | None = None
         self._sink: JsonlSink | None = None
         # TB scalars on the summary cadence (≙ chief summary writes,
@@ -167,7 +194,8 @@ class Trainer:
                 self._checkpointer = ckpt.AsyncCheckpointer()
             self._checkpointer.save(self.train_dir, self.state, at_step,
                                     extra=extra,
-                                    keep=self.cfg.train.keep_checkpoints)
+                                    keep=self.cfg.train.keep_checkpoints,
+                                    no_skip=self._sharded_ckpt)
         else:
             ckpt.save_checkpoint(self.train_dir, self.state, at_step,
                                  extra=extra,
@@ -252,6 +280,10 @@ class Trainer:
                             np.float32)
             if self.delay_injection_ms is not None:
                 local = local + np.asarray(self.delay_injection_ms, np.float32)
+            if self._last_device_skew is not None:
+                # per-device drain skew measured LAST step — the
+                # within-host divergence the uniform host dt misses
+                local = local + self._last_device_skew
             return self.topo.device_put_measured(local)
 
         def flush(now: float) -> None:
@@ -331,7 +363,16 @@ class Trainer:
                                                 seq_sharded=self.seq_sharded)
             self.state, metrics = self.step_fn(self.state, gbatch,
                                                measured_vector())
+            # host_dt is the per-HOST base time and must be captured
+            # BEFORE the probe's drain poll — otherwise one slow device
+            # would inflate every local replica's base (and the slow
+            # one's skew would double-count)
             host_dt = time.time() - t0
+            if self._device_probe is not None:
+                if self.device_work_injection:
+                    for _r, (fn, arg) in self.device_work_injection.items():
+                        fn(arg)  # async: queues real work on that device
+                self._last_device_skew = self._device_probe.measure_skew_ms()
             step += 1
             self.collector.add(metrics["step_times_ms"], host_dt)
             pending.append((step, metrics, time.time()))

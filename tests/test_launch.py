@@ -188,3 +188,136 @@ def test_cli_train_with_overrides(tmp_path, capsys):
     out = json.loads(capsys.readouterr().out.strip().split("\n")[-1])
     assert out["summary"]["final_step"] == 6
     assert "accuracy" in out["test"]
+
+
+def test_fetch_dry_run_plans_without_network(tmp_path, capsys):
+    """`launch fetch --dry-run` prints the full verify/fetch plan —
+    files, mirrors, pinned digests, cache status — with zero network or
+    cache mutation (the real-data readiness check, ≙ the reference's
+    maybe_download at src/mnist_data.py:176-187)."""
+    import json as _json
+    from distributedmnist_tpu.data.fixtures import materialize_idx_fixture
+    from distributedmnist_tpu.launch.__main__ import main
+
+    d = tmp_path / "cache"
+    materialize_idx_fixture(d, num_train=64, num_test=32)
+    before = sorted(p.name for p in d.iterdir())
+    main(["fetch", "--dataset", "mnist", "--data-dir", str(d), "--dry-run"])
+    plan = _json.loads(capsys.readouterr().out)
+    assert plan["dataset"] == "mnist"
+    assert len(plan["plan"]) == 4
+    for entry in plan["plan"]:
+        assert entry["pinned_sha256"]          # all four MNIST pins exist
+        assert entry["mirrors"]
+        # the fixture cache is either uncompressed (not verifiable) or
+        # a .gz whose digest differs from the real pins - both non-verified
+        assert entry["status"] != "verified"
+    assert sorted(p.name for p in d.iterdir()) == before   # untouched
+
+
+def test_fetch_offline_leaves_fixture_cache_intact(tmp_path, capsys):
+    """Without egress, `fetch --verify` must fail loudly (exit 1) and
+    restore the quarantined fixture files — fixture runs keep working."""
+    import json as _json
+    import pytest as _pytest
+    from distributedmnist_tpu.data import datasets as DS
+    from distributedmnist_tpu.data.fixtures import materialize_idx_fixture
+    from distributedmnist_tpu.launch.__main__ import main
+
+    d = tmp_path / "cache"
+    materialize_idx_fixture(d, num_train=64, num_test=32)
+    before = sorted(p.name for p in d.iterdir())
+    # point the mirrors somewhere unreachable without touching the net
+    orig = DS._IDX_MIRRORS["mnist"]
+    DS._IDX_MIRRORS["mnist"] = [str(tmp_path / "nonexistent") + "/"]
+    try:
+        with _pytest.raises(SystemExit) as e:
+            main(["fetch", "--dataset", "mnist", "--data-dir", str(d),
+                  "--verify"])
+        assert e.value.code == 1
+    finally:
+        DS._IDX_MIRRORS["mnist"] = orig
+    out = _json.loads(capsys.readouterr().out)
+    assert out["ok"] is False
+    assert sorted(p.name for p in d.iterdir()) == before
+    assert "Fixture dataset" in (d / "PROVENANCE.md").read_text()
+
+
+def test_fetch_verify_upgrades_fixture_to_real(tmp_path, capsys):
+    """The full upgrade flow against a hermetic file:// mirror: fetch
+    replaces the fixture with digest-verified archives and rewrites
+    PROVENANCE.md to say REAL — the one-command path the day egress
+    exists."""
+    import gzip
+    import hashlib
+    import json as _json
+    from distributedmnist_tpu.data import datasets as DS
+    from distributedmnist_tpu.data.fixtures import materialize_idx_fixture
+    from distributedmnist_tpu.launch.__main__ import main
+
+    # the "real" archives: a second fixture, gzipped, served via file://
+    mirror = tmp_path / "mirror"
+    materialize_idx_fixture(mirror, num_train=96, num_test=48)
+    del gzip  # the fixture already writes .gz archives
+    pins = {gz.name: hashlib.sha256(gz.read_bytes()).hexdigest()
+            for gz in sorted(mirror.glob("*.gz"))}
+    assert len(pins) == 4
+
+    d = tmp_path / "cache"
+    materialize_idx_fixture(d, num_train=64, num_test=32)
+    orig_m, orig_p = DS._IDX_MIRRORS["mnist"], DS._PINNED_SHA256["mnist"]
+    DS._IDX_MIRRORS["mnist"] = [mirror.as_uri() + "/"]
+    DS._PINNED_SHA256["mnist"] = pins
+    try:
+        main(["fetch", "--dataset", "mnist", "--data-dir", str(d),
+              "--verify"])
+    finally:
+        DS._IDX_MIRRORS["mnist"] = orig_m
+        DS._PINNED_SHA256["mnist"] = orig_p
+    out = _json.loads(capsys.readouterr().out)
+    assert out["ok"] is True
+    assert len(out["verified"]) == 4
+    prov = (d / "PROVENANCE.md").read_text()
+    assert "Real dataset" in prov and "sha256" in prov
+    # the installed archives are the mirror's, digest-verified
+    for name, digest in pins.items():
+        got = hashlib.sha256((d / name).read_bytes()).hexdigest()
+        assert got == digest
+
+
+def test_fetch_partial_mirror_failure_is_transactional(tmp_path, capsys):
+    """If only some archives download, fetch --verify must roll the
+    cache back EXACTLY to its pre-fetch state (no mixed real/fixture
+    cache that would crash the loader on count mismatches)."""
+    import hashlib
+    import json as _json
+    import pytest as _pytest
+    from distributedmnist_tpu.data import datasets as DS
+    from distributedmnist_tpu.data.fixtures import materialize_idx_fixture
+    from distributedmnist_tpu.launch.__main__ import main
+
+    mirror = tmp_path / "mirror"
+    materialize_idx_fixture(mirror, num_train=96, num_test=48)
+    pins = {gz.name: hashlib.sha256(gz.read_bytes()).hexdigest()
+            for gz in sorted(mirror.glob("*.gz"))}
+    # the mirror can only serve half the archives
+    (mirror / "train-labels-idx1-ubyte.gz").unlink()
+    (mirror / "t10k-labels-idx1-ubyte.gz").unlink()
+
+    d = tmp_path / "cache"
+    materialize_idx_fixture(d, num_train=64, num_test=32)
+    before = {p.name: p.read_bytes() for p in d.iterdir()}
+    orig_m, orig_p = DS._IDX_MIRRORS["mnist"], DS._PINNED_SHA256["mnist"]
+    DS._IDX_MIRRORS["mnist"] = [mirror.as_uri() + "/"]
+    DS._PINNED_SHA256["mnist"] = pins
+    try:
+        with _pytest.raises(SystemExit):
+            main(["fetch", "--dataset", "mnist", "--data-dir", str(d),
+                  "--verify"])
+    finally:
+        DS._IDX_MIRRORS["mnist"] = orig_m
+        DS._PINNED_SHA256["mnist"] = orig_p
+    out = _json.loads(capsys.readouterr().out)
+    assert out["ok"] is False
+    after = {p.name: p.read_bytes() for p in d.iterdir()}
+    assert after == before      # byte-identical rollback
