@@ -103,6 +103,14 @@ def _scan_chunks(step_fn, state, gbatch, chunk_len: int, n_chunks: int):
     state, loss = run(state, gbatch)
     float(loss)  # drain (see _drain)
     compile_s = time.perf_counter() - t0
+    # One untimed warm chunk: the first post-compile dispatch pays a
+    # host/tunnel ramp (measured 4-14 ms/step of pure jitter at the
+    # flash shape — two runs of identical code differed only there).
+    # Steady-state device throughput is the quantity every case
+    # reports; the warm chunk is excluded from the timed window
+    # uniformly, and per_step_ms_by_chunk still shows the spread.
+    state, loss = run(state, gbatch)
+    float(loss)
 
     # Dispatch every chunk before fetching any: the device queue runs
     # the chunks back-to-back while the ~70 ms tunnel relay of each
@@ -200,7 +208,9 @@ def bench_transformer_flash() -> None:
     rng = np.random.default_rng(0)
     toks = rng.integers(0, V, (B, S), dtype=np.int32)
     gbatch = topo.device_put_batch({"image": toks, "label": toks.copy()})
-    chunk_len, n_chunks = 5, 4
+    # 50 timed steps: the one tunnel-relay latency that necessarily
+    # lands in the timed window (~13 ms here) must stay <0.5% of it
+    chunk_len, n_chunks = 10, 5
     times, compile_s, _ = _scan_chunks(step_fn, state, gbatch,
                                        chunk_len, n_chunks)
     dt = sum(times)
@@ -250,7 +260,7 @@ def bench_flash_long_context() -> None:
     rng = np.random.default_rng(0)
     toks = rng.integers(0, V, (B, S), dtype=np.int32)
     gbatch = topo.device_put_batch({"image": toks, "label": toks.copy()})
-    chunk_len, n_chunks = 4, 3
+    chunk_len, n_chunks = 8, 4
     times, compile_s, _ = _scan_chunks(step_fn, state, gbatch,
                                        chunk_len, n_chunks)
     dt = sum(times)

@@ -99,6 +99,21 @@ def _fetch(args) -> None:
 
     root = Path(args.data_dir)
     dataset = args.dataset
+    # Recover quarantine files stranded by an interrupted earlier run
+    # (killed between quarantine and restore): put them back when the
+    # slot is still empty, discard them when the slot was re-filled —
+    # either way no *.quarantine survives into this run's bookkeeping.
+    # (dry-run promises zero cache mutation, so it only reports them)
+    stranded = sorted(p.name for p in root.glob("*.quarantine")) \
+        if root.is_dir() else []
+    if stranded and not args.dry_run:
+        for name in stranded:
+            aside = root / name
+            orig = aside.with_name(name[: -len(".quarantine")])
+            if orig.exists():
+                aside.unlink()
+            else:
+                aside.rename(orig)
     pins = DS._PINNED_SHA256.get(dataset, {})
     plan = []
     for key, names in DS._IDX_FILES.items():
@@ -118,7 +133,8 @@ def _fetch(args) -> None:
 
     if args.dry_run:
         print(json.dumps({"dataset": dataset, "data_dir": str(root),
-                          "plan": plan}, indent=2))
+                          "plan": plan,
+                          "stranded_quarantine": stranded}, indent=2))
         return
 
     quarantined: list[tuple] = []
@@ -136,6 +152,14 @@ def _fetch(args) -> None:
                             aside = cand.with_name(cand.name + ".quarantine")
                             cand.rename(aside)
                             quarantined.append((aside, cand))
+
+    # Snapshot AFTER quarantining: at rollback, every known-name file
+    # not in this set was installed by THIS run and must go — including
+    # downloads into slots that were empty to begin with (which have no
+    # quarantine entry to displace).
+    all_names = [n for names in DS._IDX_FILES.values()
+                 for name in names for n in (name, name + ".gz")]
+    pre_existing = {n for n in all_names if (root / n).exists()}
 
     ok = DS.maybe_download(root, dataset)
     verified = {}
@@ -157,18 +181,31 @@ def _fetch(args) -> None:
             # just not digest-pinnable — present counts as healthy
             unverifiable.append(cached.name)
 
+    downloaded = sorted(n for n in all_names
+                        if n not in pre_existing and (root / n).exists())
     if ok:
         for aside, _orig in quarantined:
             aside.unlink(missing_ok=True)
     else:
-        # transactional restore: drop any partially-downloaded
-        # replacement whose fixture was quarantined, then put every
-        # quarantined file back — the cache ends EXACTLY as it started
+        # transactional rollback: drop EVERY file this run installed
+        # (quarantine-displacing replacements AND downloads into
+        # previously-empty slots), then put every quarantined file
+        # back — the cache ends exactly as it started
+        for n in downloaded:
+            (root / n).unlink(missing_ok=True)
         for aside, orig in quarantined:
             orig.unlink(missing_ok=True)
             aside.rename(orig)
 
-    if ok:
+    # PROVENANCE.md is only rewritten when this run actually
+    # established real data: it downloaded archives, or it
+    # digest-verified every slot. A cache this run neither fetched nor
+    # verified (unpinnable idx files, --verify not passed) keeps
+    # whatever provenance it had — fetch must never relabel a fixture
+    # as real.
+    establishes_real = bool(downloaded) or (
+        bool(pins) and len(verified) == len(DS._IDX_FILES))
+    if ok and establishes_real:
         (root / "PROVENANCE.md").write_text(
             f"# Real dataset ({dataset})\n\n"
             f"Downloaded and installed by `launch fetch` at "
@@ -182,10 +219,13 @@ def _fetch(args) -> None:
             + ("".join(f"- `{n}`: present, structurally valid, no digest "
                        "pin applicable\n" for n in sorted(unverifiable))
                if unverifiable else ""))
+    if ok:
         print(json.dumps({"ok": True, "dataset": dataset,
                           "data_dir": str(root),
+                          "downloaded": downloaded,
                           "verified": sorted(verified),
-                          "unverifiable": sorted(unverifiable)}))
+                          "unverifiable": sorted(unverifiable),
+                          "provenance_updated": establishes_real}))
     else:
         print(json.dumps({"ok": False, "dataset": dataset,
                           "data_dir": str(root),

@@ -185,10 +185,16 @@ def _transformer(cfg: ModelConfig) -> Model:
             max_seq_len=cfg.seq_len, num_experts=cfg.num_experts)
 
     if cfg.attention_impl == "flash":
-        from ..ops.pallas_attention import flash_attention
-        attention_fn = flash_attention
+        from ..ops.pallas_attention import (flash_attention,
+                                            flash_attention_bshd)
+        # the model body sees the bshd entry (no head transposes); the
+        # SP wrappers below keep the bhsd entry — Ulysses' all-to-all
+        # output is already head-major
+        attention_fn = flash_attention_bshd
+        inner_bhsd = flash_attention
     elif cfg.attention_impl == "dense":
         attention_fn = None  # transformer defaults to local_self_attention
+        inner_bhsd = None
     else:
         raise ValueError(f"unknown attention_impl {cfg.attention_impl!r}")
 
@@ -219,7 +225,7 @@ def _transformer(cfg: ModelConfig) -> Model:
             return sharded_attn
         if cfg.sp_attention == "ulysses":
             from ..ops.ulysses_attention import ulysses_self_attention
-            inner = attention_fn
+            inner = inner_bhsd
 
             def sharded_attn(q, k, v, causal=True, scale=None):
                 return ulysses_self_attention(q, k, v, seq_axis,

@@ -199,12 +199,21 @@ def _apply_block(x: jax.Array, blk: Params, *, h_local: int, hd: int,
     h = _rms_norm(x, blk["ln1"])
     qkv = jnp.einsum("bsd,dte->bste", h, blk["wqkv"])  # e = d/m
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if getattr(attn, "layout", "bhsd") == "bshd":
+        # kernel reads the residual layout directly ([b, s, h, hd] is a
+        # free reshape of [b, s, e]) — no head transpose on either side.
+        # At the flash bench shape the transposes a bhsd attention
+        # forces cost ~20 ms/step, 2.5× the kernel itself. (A fully
+        # fused qkv-packed kernel input was also measured: the strided
+        # k/v lane reads cost MORE than the slice copies they save.)
+        bshd = lambda t: t.reshape(b, -1, h_local, hd)
+        o = attn(bshd(q), bshd(k), bshd(v)).reshape(b, -1, h_local * hd)
+    else:
+        def heads(t):
+            return t.reshape(b, -1, h_local, hd).transpose(0, 2, 1, 3)
 
-    def heads(t):
-        return t.reshape(b, -1, h_local, hd).transpose(0, 2, 1, 3)
-
-    o = attn(heads(q), heads(k), heads(v))
-    o = o.transpose(0, 2, 1, 3).reshape(b, -1, h_local * hd)
+        o = attn(heads(q), heads(k), heads(v))
+        o = o.transpose(0, 2, 1, 3).reshape(b, -1, h_local * hd)
     proj = o @ blk["wo"]  # row-parallel: partial sum of the full d
     if model_axis:
         proj = lax.psum(proj, model_axis)

@@ -285,6 +285,101 @@ def test_fetch_verify_upgrades_fixture_to_real(tmp_path, capsys):
         assert got == digest
 
 
+def test_fetch_rolls_back_downloads_into_empty_slots(tmp_path, capsys):
+    """A failed fetch must also delete archives it downloaded into
+    slots that were EMPTY beforehand (no quarantine entry to displace)
+    — otherwise a real 96-row train-images coexists with the 64-row
+    fixture labels and the next fixture run crashes on count mismatch."""
+    import json as _json
+    import pytest as _pytest
+    from distributedmnist_tpu.data import datasets as DS
+    from distributedmnist_tpu.data.fixtures import materialize_idx_fixture
+    from distributedmnist_tpu.launch.__main__ import main
+    import hashlib
+
+    mirror = tmp_path / "mirror"
+    materialize_idx_fixture(mirror, num_train=96, num_test=48)
+    pins = {gz.name: hashlib.sha256(gz.read_bytes()).hexdigest()
+            for gz in sorted(mirror.glob("*.gz"))}
+    (mirror / "train-labels-idx1-ubyte.gz").unlink()  # mirror 404s labels
+
+    d = tmp_path / "cache"
+    materialize_idx_fixture(d, num_train=64, num_test=32)
+    (d / "train-images-idx3-ubyte.gz").unlink()  # empty slot pre-fetch
+    before = {p.name: p.read_bytes() for p in d.iterdir()}
+    orig_m, orig_p = DS._IDX_MIRRORS["mnist"], DS._PINNED_SHA256["mnist"]
+    DS._IDX_MIRRORS["mnist"] = [mirror.as_uri() + "/"]
+    DS._PINNED_SHA256["mnist"] = pins
+    try:
+        with _pytest.raises(SystemExit):
+            main(["fetch", "--dataset", "mnist", "--data-dir", str(d),
+                  "--verify"])
+    finally:
+        DS._IDX_MIRRORS["mnist"] = orig_m
+        DS._PINNED_SHA256["mnist"] = orig_p
+    assert _json.loads(capsys.readouterr().out)["ok"] is False
+    after = {p.name: p.read_bytes() for p in d.iterdir()}
+    assert after == before  # the downloaded train-images is GONE
+
+
+def test_fetch_does_not_relabel_unverified_cache_as_real(tmp_path, capsys):
+    """`fetch` (no --verify) over a cache of unpinnable idx files must
+    not rewrite PROVENANCE.md: nothing was downloaded or verified, so
+    claiming 'Real dataset / Downloaded and installed' would let the
+    99% oracle run on synthetic pixels labeled as real."""
+    import json as _json
+    from distributedmnist_tpu.data.fixtures import materialize_idx_fixture
+    from distributedmnist_tpu.launch.__main__ import main
+
+    d = tmp_path / "cache"
+    materialize_idx_fixture(d, num_train=64, num_test=32, gzip_files=False)
+    prov_before = (d / "PROVENANCE.md").read_text()
+    assert "Fixture dataset" in prov_before
+    main(["fetch", "--dataset", "mnist", "--data-dir", str(d)])
+    out = _json.loads(capsys.readouterr().out)
+    assert out["ok"] is True
+    assert out["downloaded"] == []
+    assert out["provenance_updated"] is False
+    assert (d / "PROVENANCE.md").read_text() == prov_before
+
+
+def test_fetch_recovers_stranded_quarantine(tmp_path, capsys):
+    """A crash between quarantine and restore leaves *.quarantine files
+    behind; the next fetch must put them back (slot empty) or discard
+    them (slot re-filled) before planning — an offline box must never
+    need manual renames to get its fixture cache working again."""
+    import json as _json
+    import pytest as _pytest
+    from distributedmnist_tpu.data import datasets as DS
+    from distributedmnist_tpu.data.fixtures import materialize_idx_fixture
+    from distributedmnist_tpu.launch.__main__ import main
+
+    d = tmp_path / "cache"
+    materialize_idx_fixture(d, num_train=64, num_test=32)
+    before = sorted(p.name for p in d.iterdir())
+    # simulate the interrupted run: one slot stranded mid-quarantine
+    gz = d / "train-images-idx3-ubyte.gz"
+    gz.rename(gz.with_name(gz.name + ".quarantine"))
+
+    # dry-run only REPORTS (no mutation promised)
+    main(["fetch", "--dataset", "mnist", "--data-dir", str(d), "--dry-run"])
+    plan = _json.loads(capsys.readouterr().out)
+    assert plan["stranded_quarantine"] == [gz.name + ".quarantine"]
+    assert (d / (gz.name + ".quarantine")).exists()
+
+    # a real (offline, failing) fetch first repairs the cache
+    orig = DS._IDX_MIRRORS["mnist"]
+    DS._IDX_MIRRORS["mnist"] = [str(tmp_path / "nonexistent") + "/"]
+    try:
+        with _pytest.raises(SystemExit):
+            main(["fetch", "--dataset", "mnist", "--data-dir", str(d),
+                  "--verify"])
+    finally:
+        DS._IDX_MIRRORS["mnist"] = orig
+    capsys.readouterr()
+    assert sorted(p.name for p in d.iterdir()) == before  # fully restored
+
+
 def test_fetch_partial_mirror_failure_is_transactional(tmp_path, capsys):
     """If only some archives download, fetch --verify must roll the
     cache back EXACTLY to its pre-fetch state (no mixed real/fixture
