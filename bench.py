@@ -412,20 +412,31 @@ def bench_native_loader() -> None:
             float(pending[-1])            # GIL-free park in the relay
             pending.clear()
 
+    # The native iterator is measured at TWO queue depths: the
+    # PRODUCTION default (DataConfig.prefetch_batches = 2 — the depth
+    # make_train_iterator's 1-core gate actually governs) and a deep
+    # queue (=cadence). Round 4 benched only the deep queue and its
+    # 1.07x contradicted the gate's depth-2 measurement; at matched
+    # depth the gate and the bench agree (native ~0.90x on this
+    # 1-core host — the gate correctly disables it).
+    prod_depth = DataConfig().prefetch_batches
+    variants = [("python", None), ("native", prod_depth),
+                ("native_deep", cadence)]
+
     rates: dict = {}
     for shape, consume in (("cpu_busy", consume_cpu_busy),
                            ("device_blocked", consume_device_blocked)):
-        for label in ("python", "native"):
+        for label, depth in variants:
             it = BatchIterator(ds.train, batch, seed=0)
-            if label == "native":
+            if depth is not None:
                 try:
                     from distributedmnist_tpu.data.native_loader import (
                         NativePrefetcher)
                 except ImportError as e:  # no C++ toolchain: still report
-                    rates[f"{shape}_native"] = None
+                    rates[f"{shape}_{label}"] = None
                     rates["native_error"] = f"{type(e).__name__}: {e}"
                     continue
-                it = NativePrefetcher(it, depth=cadence)
+                it = NativePrefetcher(it, depth=depth)
             next(it)  # spin-up cost out of the timed window
             pending: list = []
             t0 = time.perf_counter()
@@ -436,23 +447,33 @@ def bench_native_loader() -> None:
             if hasattr(it, "close"):
                 it.close()
 
-    def ratio(shape: str):
-        n, p = rates.get(f"{shape}_native"), rates.get(f"{shape}_python")
+    def ratio(shape: str, label: str = "native"):
+        n, p = rates.get(f"{shape}_{label}"), rates.get(f"{shape}_python")
         return round(n / p, 2) if n and p else rates.get("native_error")
 
+    prod_ratio = ratio("device_blocked")
     native = rates.get("device_blocked_native")
     _case({"metric": "native_loader_overlapped_batches_per_sec",
            "value": round(native, 1) if native else None,
            "unit": "batches/sec",
-           "detail": {"pipeline_speedup_vs_python": ratio("device_blocked"),
+           "detail": {"prefetch_depth_production": prod_depth,
+                      "pipeline_speedup_vs_python": prod_ratio,
+                      "pipeline_speedup_deep_queue": ratio(
+                          "device_blocked", "native_deep"),
                       "cpu_busy_speedup_vs_python": ratio("cpu_busy"),
+                      "gate_decision_matches_bench": (
+                          None if not isinstance(prod_ratio, float)
+                          else bool((prod_ratio < 1.0)
+                                    == ((os.cpu_count() or 1) < 2))),
                       "rates_batches_per_sec": {
                           k: round(v, 1) for k, v in rates.items()
                           if isinstance(v, float)},
                       "batch": batch, "fetch_cadence": cadence,
                       "host_cpu_count": os.cpu_count(),
                       "backend": jax.default_backend(),
-                      "idx_decode": decode}})
+                      "idx_decode": decode,
+                      "idx_decode_production_path": "python (faster; "
+                      "native reader kept for C-ABI tests)"}})
 
 
 def main() -> None:

@@ -141,13 +141,16 @@ def make_train_iterator(data: ArrayDataset, cfg: DataConfig, seed: int,
         if (os.cpu_count() or 1) < 2:
             # a prefetch thread can only fight the consumer for the one
             # core — measured as a net slowdown by bench_native_loader
-            # under BOTH consumer shapes: cpu-busy (0.5x) AND the train
-            # loop's real device-blocked shape, where the host parks
-            # GIL-free in the ~70 ms tunnel fetch (0.89x: the parked
-            # window is long enough to pre-build a few batches, but the
-            # per-batch queue handoff on one core costs more than the
-            # ~2 ms prep it hides). Prefetching pays off when a SPARE
-            # core runs the producer.
+            # under BOTH consumer shapes: cpu-busy (~0.6x) AND the
+            # train loop's real device-blocked shape AT THE PRODUCTION
+            # DEPTH of prefetch_batches=2 (median 0.90x over repeated
+            # quiet-box runs). The earlier BENCH_r04 1.07x for this
+            # case was measured at depth=10 — re-measured at depth 10
+            # it is break-even noise (0.96-1.03x across runs), and at
+            # the depth this gate actually governs the native path
+            # loses: the per-batch queue handoff on one core costs
+            # more than the ~2 ms prep it hides. Prefetching pays off
+            # when a SPARE core runs the producer.
             get_logger("data").info(
                 "single-core host: skipping the prefetch thread, "
                 "using inline batching")

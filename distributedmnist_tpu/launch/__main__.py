@@ -119,6 +119,19 @@ def _fetch(args) -> None:
     for key, names in DS._IDX_FILES.items():
         gz = names[0] + ".gz"
         cached = DS._find_idx(root, names)
+        if cached is None and any(n + q == s for n in names
+                                  for q in ("", ".gz")
+                                  for s in (x[: -len(".quarantine")]
+                                            for x in stranded)):
+            # dry-run only: a real fetch recovers the stranded file
+            # first, so "missing" would misstate what it will do
+            plan.append({"file": gz, "cached": None,
+                         "status": "stranded quarantine (a non-dry-run "
+                                   "fetch recovers it before planning)",
+                         "pinned_sha256": pins.get(gz),
+                         "mirrors": [b + gz
+                                     for b in DS._IDX_MIRRORS[dataset]]})
+            continue
         status = "missing"
         if cached is not None:
             if cached.name in pins:

@@ -361,11 +361,15 @@ def test_fetch_recovers_stranded_quarantine(tmp_path, capsys):
     gz = d / "train-images-idx3-ubyte.gz"
     gz.rename(gz.with_name(gz.name + ".quarantine"))
 
-    # dry-run only REPORTS (no mutation promised)
+    # dry-run only REPORTS (no mutation promised) — and its plan must
+    # say the slot will be recovered, not claim a download is needed
     main(["fetch", "--dataset", "mnist", "--data-dir", str(d), "--dry-run"])
     plan = _json.loads(capsys.readouterr().out)
     assert plan["stranded_quarantine"] == [gz.name + ".quarantine"]
     assert (d / (gz.name + ".quarantine")).exists()
+    by_file = {e["file"]: e["status"] for e in plan["plan"]}
+    assert "stranded quarantine" in by_file["train-images-idx3-ubyte.gz"]
+    assert "missing" not in by_file["train-images-idx3-ubyte.gz"]
 
     # a real (offline, failing) fetch first repairs the cache
     orig = DS._IDX_MIRRORS["mnist"]
