@@ -99,14 +99,20 @@ def _fetch(args) -> None:
 
     root = Path(args.data_dir)
     dataset = args.dataset
-    # Recover quarantine files stranded by an interrupted earlier run
-    # (killed between quarantine and restore): put them back when the
-    # slot is still empty, discard them when the slot was re-filled —
-    # either way no *.quarantine survives into this run's bookkeeping.
-    # (dry-run promises zero cache mutation, so it only reports them)
-    stranded = sorted(p.name for p in root.glob("*.quarantine")) \
-        if root.is_dir() else []
-    if stranded and not args.dry_run:
+    pins = DS._PINNED_SHA256.get(dataset, {})
+
+    def list_stranded():
+        """*.quarantine files left by an interrupted earlier run
+        (killed between quarantine and restore)."""
+        return (sorted(p.name for p in root.glob("*.quarantine"))
+                if root.is_dir() else [])
+
+    def recover(stranded):
+        """Put a stranded file back when its slot is still empty,
+        discard it when the slot was re-filled — either way no
+        *.quarantine survives into this run's bookkeeping. Must run
+        under the fetch lock: a LIVE peer's quarantine files are
+        indistinguishable from stranded ones."""
         for name in stranded:
             aside = root / name
             orig = aside.with_name(name[: -len(".quarantine")])
@@ -114,138 +120,174 @@ def _fetch(args) -> None:
                 aside.unlink()
             else:
                 aside.rename(orig)
-    pins = DS._PINNED_SHA256.get(dataset, {})
-    plan = []
-    for key, names in DS._IDX_FILES.items():
-        gz = names[0] + ".gz"
-        cached = DS._find_idx(root, names)
-        if cached is None and any(n + q == s for n in names
-                                  for q in ("", ".gz")
-                                  for s in (x[: -len(".quarantine")]
-                                            for x in stranded)):
-            # dry-run only: a real fetch recovers the stranded file
-            # first, so "missing" would misstate what it will do
-            plan.append({"file": gz, "cached": None,
-                         "status": "stranded quarantine (a non-dry-run "
-                                   "fetch recovers it before planning)",
-                         "pinned_sha256": pins.get(gz),
+
+    def build_plan(recovered):
+        plan = []
+        for key, names in DS._IDX_FILES.items():
+            gz = names[0] + ".gz"
+            cached = DS._find_idx(root, names)
+            if cached is None and any(n in recovered
+                                      or n + ".gz" in recovered
+                                      for n in names):
+                # dry-run only: a real fetch recovers the stranded file
+                # first, so "missing" would misstate what it will do
+                plan.append({"file": gz, "cached": None,
+                             "status": "stranded quarantine (a "
+                                       "non-dry-run fetch recovers it "
+                                       "before planning)",
+                             "pinned_sha256": pins.get(gz),
+                             "mirrors": [b + gz
+                                         for b in DS._IDX_MIRRORS[dataset]]})
+                continue
+            status = "missing"
+            if cached is not None:
+                if cached.name in pins:
+                    got = hashlib.sha256(cached.read_bytes()).hexdigest()
+                    status = ("verified" if got == pins[cached.name]
+                              else "DIGEST MISMATCH")
+                else:
+                    status = ("cached, not digest-verifiable "
+                              "(fixture or raw idx)")
+            plan.append({"file": gz,
+                         "cached": str(cached) if cached else None,
+                         "status": status, "pinned_sha256": pins.get(gz),
                          "mirrors": [b + gz
                                      for b in DS._IDX_MIRRORS[dataset]]})
-            continue
-        status = "missing"
-        if cached is not None:
-            if cached.name in pins:
-                got = hashlib.sha256(cached.read_bytes()).hexdigest()
-                status = ("verified" if got == pins[cached.name]
-                          else "DIGEST MISMATCH")
-            else:
-                status = "cached, not digest-verifiable (fixture or raw idx)"
-        plan.append({"file": gz, "cached": str(cached) if cached else None,
-                     "status": status, "pinned_sha256": pins.get(gz),
-                     "mirrors": [b + gz for b in DS._IDX_MIRRORS[dataset]]})
+        return plan
 
     if args.dry_run:
+        # zero mutation (and no lock): stranded files are reported and
+        # annotated in the plan, not recovered
+        stranded = list_stranded()
+        plan = build_plan({x[: -len(".quarantine")] for x in stranded})
         print(json.dumps({"dataset": dataset, "data_dir": str(root),
                           "plan": plan,
                           "stranded_quarantine": stranded}, indent=2))
         return
 
-    quarantined: list[tuple] = []
-    if args.verify:
-        # anything cached that cannot be digest-verified (the synthetic
-        # fixture, an unpinned raw idx, a mismatch) steps ASIDE so the
-        # download below replaces it with the verifiable archive — but
-        # only a successful download deletes it: without egress the
-        # fixture cache must survive intact
-        for entry, (key, names) in zip(plan, DS._IDX_FILES.items()):
-            if entry["cached"] and entry["status"] != "verified":
-                for name in names:
-                    for cand in (root / name, root / (name + ".gz")):
-                        if cand.exists():
-                            aside = cand.with_name(cand.name + ".quarantine")
-                            cand.rename(aside)
-                            quarantined.append((aside, cand))
+    # Everything that mutates the cache — stranded recovery, planning
+    # against the recovered state, quarantine, download, and the
+    # commit/rollback — runs under an exclusive per-data-dir flock.
+    # The rollback deletes every known-name file that postdates this
+    # run's snapshot, which would destroy archives a concurrent peer
+    # installed, and an unlocked recovery would un-quarantine a live
+    # peer's files mid-fetch. The lock lives under the system temp dir
+    # (keyed on the resolved cache path) so the cache itself stays
+    # byte-identical across a failed fetch; it therefore serializes
+    # same-HOST fetches only — distinct hosts sharing one NFS dir fall
+    # back to maybe_download's atomic per-file installs, as before
+    # (flock over NFS is not dependable anyway).
+    import fcntl
+    import tempfile
+    root.mkdir(parents=True, exist_ok=True)
+    lock_name = ("dmt_fetch_"
+                 + hashlib.sha256(str(root.resolve()).encode())
+                 .hexdigest()[:16] + ".lock")
+    lock_f = open(Path(tempfile.gettempdir()) / lock_name, "w")
+    fcntl.flock(lock_f, fcntl.LOCK_EX)
+    try:
+        recover(list_stranded())
+        plan = build_plan(set())
 
-    # Snapshot AFTER quarantining: at rollback, every known-name file
-    # not in this set was installed by THIS run and must go — including
-    # downloads into slots that were empty to begin with (which have no
-    # quarantine entry to displace).
-    all_names = [n for names in DS._IDX_FILES.values()
-                 for name in names for n in (name, name + ".gz")]
-    pre_existing = {n for n in all_names if (root / n).exists()}
+        quarantined: list[tuple] = []
+        if args.verify:
+            # anything cached that cannot be digest-verified (the synthetic
+            # fixture, an unpinned raw idx, a mismatch) steps ASIDE so the
+            # download below replaces it with the verifiable archive — but
+            # only a successful download deletes it: without egress the
+            # fixture cache must survive intact
+            for entry, (key, names) in zip(plan, DS._IDX_FILES.items()):
+                if entry["cached"] and entry["status"] != "verified":
+                    for name in names:
+                        for cand in (root / name, root / (name + ".gz")):
+                            if cand.exists():
+                                aside = cand.with_name(cand.name + ".quarantine")
+                                cand.rename(aside)
+                                quarantined.append((aside, cand))
 
-    ok = DS.maybe_download(root, dataset)
-    verified = {}
-    unverifiable = []
-    for key, names in DS._IDX_FILES.items():
-        cached = DS._find_idx(root, names)
-        if cached is None:
-            ok = False
-            continue
-        if cached.name in pins:
-            got = hashlib.sha256(cached.read_bytes()).hexdigest()
-            if got != pins[cached.name]:
+        # Snapshot AFTER quarantining: at rollback, every known-name file
+        # not in this set was installed by THIS run and must go — including
+        # downloads into slots that were empty to begin with (which have no
+        # quarantine entry to displace).
+        all_names = [n for names in DS._IDX_FILES.values()
+                     for name in names for n in (name, name + ".gz")]
+        pre_existing = {n for n in all_names if (root / n).exists()}
+
+        ok = DS.maybe_download(root, dataset)
+        verified = {}
+        unverifiable = []
+        for key, names in DS._IDX_FILES.items():
+            cached = DS._find_idx(root, names)
+            if cached is None:
                 ok = False
                 continue
-            verified[cached.name] = got
+            if cached.name in pins:
+                got = hashlib.sha256(cached.read_bytes()).hexdigest()
+                if got != pins[cached.name]:
+                    ok = False
+                    continue
+                verified[cached.name] = got
+            else:
+                # a legitimate cache of uncompressed idx files (or an
+                # unpinned dataset): structurally validated on install,
+                # just not digest-pinnable — present counts as healthy
+                unverifiable.append(cached.name)
+
+        downloaded = sorted(n for n in all_names
+                            if n not in pre_existing and (root / n).exists())
+        if ok:
+            for aside, _orig in quarantined:
+                aside.unlink(missing_ok=True)
         else:
-            # a legitimate cache of uncompressed idx files (or an
-            # unpinned dataset): structurally validated on install,
-            # just not digest-pinnable — present counts as healthy
-            unverifiable.append(cached.name)
+            # transactional rollback: drop EVERY file this run installed
+            # (quarantine-displacing replacements AND downloads into
+            # previously-empty slots), then put every quarantined file
+            # back — the cache ends exactly as it started
+            for n in downloaded:
+                (root / n).unlink(missing_ok=True)
+            for aside, orig in quarantined:
+                orig.unlink(missing_ok=True)
+                aside.rename(orig)
 
-    downloaded = sorted(n for n in all_names
-                        if n not in pre_existing and (root / n).exists())
-    if ok:
-        for aside, _orig in quarantined:
-            aside.unlink(missing_ok=True)
-    else:
-        # transactional rollback: drop EVERY file this run installed
-        # (quarantine-displacing replacements AND downloads into
-        # previously-empty slots), then put every quarantined file
-        # back — the cache ends exactly as it started
-        for n in downloaded:
-            (root / n).unlink(missing_ok=True)
-        for aside, orig in quarantined:
-            orig.unlink(missing_ok=True)
-            aside.rename(orig)
-
-    # PROVENANCE.md is only rewritten when this run actually
-    # established real data: it downloaded archives, or it
-    # digest-verified every slot. A cache this run neither fetched nor
-    # verified (unpinnable idx files, --verify not passed) keeps
-    # whatever provenance it had — fetch must never relabel a fixture
-    # as real.
-    establishes_real = bool(downloaded) or (
-        bool(pins) and len(verified) == len(DS._IDX_FILES))
-    if ok and establishes_real:
-        (root / "PROVENANCE.md").write_text(
-            f"# Real dataset ({dataset})\n\n"
-            f"Downloaded and installed by `launch fetch` at "
-            f"{time.strftime('%Y-%m-%d %H:%M:%S UTC', time.gmtime())}.\n"
-            + ("Archives verified against the pinned sha256 digests "
-               "(distributedmnist_tpu/data/datasets.py:_PINNED_SHA256):\n\n"
-               + "".join(f"- `{k}`: `{v}`\n" for k, v in sorted(verified.items()))
-               if verified else
-               "No digest-pinnable archives (structural idx validation "
-               "applied on install).\n")
-            + ("".join(f"- `{n}`: present, structurally valid, no digest "
-                       "pin applicable\n" for n in sorted(unverifiable))
-               if unverifiable else ""))
-    if ok:
-        print(json.dumps({"ok": True, "dataset": dataset,
-                          "data_dir": str(root),
-                          "downloaded": downloaded,
-                          "verified": sorted(verified),
-                          "unverifiable": sorted(unverifiable),
-                          "provenance_updated": establishes_real}))
-    else:
-        print(json.dumps({"ok": False, "dataset": dataset,
-                          "data_dir": str(root),
-                          "hint": "no egress or mirror/digest failure; "
-                                  "the cache was left as-is (fixture runs "
-                                  "keep working)"}))
-        sys.exit(1)
+        # PROVENANCE.md is only rewritten when this run actually
+        # established real data: it downloaded archives, or it
+        # digest-verified every slot. A cache this run neither fetched nor
+        # verified (unpinnable idx files, --verify not passed) keeps
+        # whatever provenance it had — fetch must never relabel a fixture
+        # as real.
+        establishes_real = bool(downloaded) or (
+            bool(pins) and len(verified) == len(DS._IDX_FILES))
+        if ok and establishes_real:
+            (root / "PROVENANCE.md").write_text(
+                f"# Real dataset ({dataset})\n\n"
+                f"Downloaded and installed by `launch fetch` at "
+                f"{time.strftime('%Y-%m-%d %H:%M:%S UTC', time.gmtime())}.\n"
+                + ("Archives verified against the pinned sha256 digests "
+                   "(distributedmnist_tpu/data/datasets.py:_PINNED_SHA256):\n\n"
+                   + "".join(f"- `{k}`: `{v}`\n" for k, v in sorted(verified.items()))
+                   if verified else
+                   "No digest-pinnable archives (structural idx validation "
+                   "applied on install).\n")
+                + ("".join(f"- `{n}`: present, structurally valid, no digest "
+                           "pin applicable\n" for n in sorted(unverifiable))
+                   if unverifiable else ""))
+        if ok:
+            print(json.dumps({"ok": True, "dataset": dataset,
+                              "data_dir": str(root),
+                              "downloaded": downloaded,
+                              "verified": sorted(verified),
+                              "unverifiable": sorted(unverifiable),
+                              "provenance_updated": establishes_real}))
+        else:
+            print(json.dumps({"ok": False, "dataset": dataset,
+                              "data_dir": str(root),
+                              "hint": "no egress or mirror/digest failure; "
+                                      "the cache was left as-is (fixture runs "
+                                      "keep working)"}))
+            sys.exit(1)
+    finally:
+        fcntl.flock(lock_f, fcntl.LOCK_UN)
+        lock_f.close()
 
 
 def _devices(_args) -> None:

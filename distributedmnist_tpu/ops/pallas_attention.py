@@ -123,18 +123,19 @@ def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
 
 # Measured (block_q, block_k) table for v5e ("TPU v5 lite", bf16,
 # head_dim ≤ 128), keyed by the smallest table seq ≥ s. Swept on-chip
-# with scan-chunk timing (one dispatch per 12-50 kernel chains so the
-# tunnel relay amortizes; fwd+bwd = custom-vjp fwd + dq + dkv kernels):
-# at S=1024 the (1024,1024) entry runs the train path 1.8× faster than
-# the old fixed (512,512) default (3.73 → 2.04 ms), and at S=8192
-# (512,1024) reaches 172 TF/s vs 113 for (512,512). Entries stay ≤1024:
+# with scan-chunk timing (one dispatch per 10-50 kernel chains so the
+# tunnel relay amortizes) over the FULL train composition — custom-vjp
+# forward + dq + dkv kernels with all three cotangents consumed (an
+# earlier sweep whose chain used only dq let XLA dead-code the dkv
+# kernel and mis-ranked (512,1024) at depth): (1024,1024) wins at
+# every S ≥ 1024 — 4.66 ms vs 7.78 for the old fixed (512,512) at the
+# S=1024 bench shape, 11.0 vs 16.3 at S=8192. Blocks stay ≤1024:
 # 2048-wide blocks exceed the 16 MB scoped-VMEM stack limit at depth
 # (compile-time OOM in the dkv kernel). Callers can still override
 # explicitly; other chips inherit the table as a heuristic.
 _TUNED_BLOCKS = (
     (512, (512, 512)),
-    (2048, (1024, 1024)),
-    (1 << 62, (512, 1024)),
+    (1 << 62, (1024, 1024)),
 )
 
 
@@ -429,8 +430,8 @@ def _backward(q, k, v, out, lse, dout, causal: bool, scale: float,
         dq, dk, dv = pl.pallas_call(
             functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
                               block_q=block_q, block_k=block_k, seq_len=s),
-            out_shape=[_vma_sds((bb, sp, hdp), q.dtype, qp, kp, vp, dop)
-                       for _ in range(3)],
+            out_shape=[_vma_sds((bb, sp, hdp), t.dtype, qp, kp, vp, dop)
+                       for t in (q, k, v)],
             grid=(bb, hh, 1, 1),
             in_specs=[fspec, fspec, fspec, fspec, fspec, flane],
             out_specs=[fspec, fspec, fspec],

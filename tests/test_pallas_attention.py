@@ -105,6 +105,27 @@ def test_pallas_backward_matches_oracle(causal, s, d, bq, bk):
                                    atol=2e-4, rtol=2e-3)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_fused_and_split_backward_agree(causal):
+    """The single-visit fused backward (taken when the whole sequence
+    fits one block pair) vs the split dq/dkv kernels at the SAME
+    geometry — block overrides select the path: (128,128) at s=128 is
+    one block pair (fused), (64,64) is a 2x2 grid (split). Pins that
+    the two implementations cannot drift apart numerically."""
+    q, k, v = _qkv(jax.random.PRNGKey(11), b=2, h=2, s=128, d=64)
+
+    def g(bq, bk):
+        return jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=causal, block_q=bq, block_k=bk) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+
+    fused = g(128, 128)
+    split = g(64, 64)
+    for a, b in zip(fused, split):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-3)
+
+
 def test_pallas_backward_bf16_io():
     q, k, v = _qkv(jax.random.PRNGKey(8), s=64, d=32, dtype=jnp.bfloat16)
 
