@@ -183,7 +183,13 @@ def _fetch(args) -> None:
     lock_name = ("dmt_fetch_"
                  + hashlib.sha256(str(root.resolve()).encode())
                  .hexdigest()[:16] + ".lock")
-    lock_f = open(Path(tempfile.gettempdir()) / lock_name, "w")
+    import os as _os
+    # O_CREAT|O_RDWR with 0o666 (not open(..., "w")): on a shared
+    # machine a second user must be able to open the SAME lock file —
+    # "w" would both truncate and fail on the other user's 0644 file
+    lock_fd = _os.open(Path(tempfile.gettempdir()) / lock_name,
+                       _os.O_CREAT | _os.O_RDWR, 0o666)
+    lock_f = _os.fdopen(lock_fd, "r+")
     fcntl.flock(lock_f, fcntl.LOCK_EX)
     try:
         recover(list_stranded())

@@ -198,6 +198,17 @@ def _transformer(cfg: ModelConfig) -> Model:
     else:
         raise ValueError(f"unknown attention_impl {cfg.attention_impl!r}")
 
+    if (cfg.remat and cfg.remat_policy == "save_attn"
+            and cfg.attention_impl != "flash"):
+        # save_attn keeps the attention sublayer's AD residuals
+        # resident; only the flash kernel's custom VJP bounds those at
+        # O(s·d) — dense attention would park the [b, h, s, s] softmax
+        # probabilities in HBM per layer, defeating remat entirely
+        raise ValueError(
+            "model.remat_policy='save_attn' requires "
+            "attention_impl='flash' (dense attention has no fused VJP; "
+            "its resident residuals would be O(seq²) per layer)")
+
     def apply(params, x, *, train=False, dropout_key=None, return_aux=False):
         del dropout_key
         return transformer.apply(params, x, num_heads=cfg.num_heads,
@@ -208,6 +219,7 @@ def _transformer(cfg: ModelConfig) -> Model:
                                  moe_num_groups=cfg.moe_num_groups,
                                  moe_router_top_k=cfg.moe_router_top_k,
                                  remat=cfg.remat,
+                                 remat_policy=cfg.remat_policy,
                                  return_aux=return_aux)
 
     def make_seq_attn(seq_axis: str | None):
@@ -245,6 +257,18 @@ def _transformer(cfg: ModelConfig) -> Model:
         if expert_axis is not None and not moe:
             raise ValueError("mesh has expert parallelism but the model has "
                              "no experts (model.num_experts == 0)")
+        if (cfg.remat and cfg.remat_policy == "save_attn"
+                and seq_axis is not None and cfg.sp_attention == "ring"):
+            # save_attn keeps the attention sublayer outside the
+            # checkpoint so the flash kernel's O(s·d) custom-vjp
+            # residuals stay resident; ring attention has no custom
+            # vjp — AD would save its per-ppermute-step scan residuals
+            # instead, exactly the memory remat exists to avoid
+            raise ValueError(
+                "model.remat_policy='save_attn' requires an attention "
+                "with a fused VJP (flash / Ulysses-over-flash); ring "
+                "attention under sequence parallelism needs "
+                "remat_policy='full'")
 
         # SP×MoE: tokens are already seq-sharded; routing runs on each
         # shard's slice with shard-local capacity (ops/moe.py module
@@ -264,6 +288,7 @@ def _transformer(cfg: ModelConfig) -> Model:
                                      moe_num_groups=cfg.moe_num_groups,
                                      moe_router_top_k=cfg.moe_router_top_k,
                                      remat=cfg.remat,
+                                     remat_policy=cfg.remat_policy,
                                      moe_stats_axes=stats_axes,
                                      return_aux=return_aux)
 
@@ -276,6 +301,14 @@ def _transformer(cfg: ModelConfig) -> Model:
         if expert_axis is not None and not moe:
             raise ValueError("mesh has expert parallelism but the model has "
                              "no experts (model.num_experts == 0)")
+        if cfg.remat and cfg.remat_policy != "full":
+            # the pipeline stage scans checkpoint whole layers; a
+            # silently-ignored policy would leave the user at full-remat
+            # throughput while believing save_attn is on
+            raise ValueError(
+                f"model.remat_policy={cfg.remat_policy!r} is not "
+                "supported under pipeline parallelism (stage scans use "
+                "full per-layer remat); set remat_policy='full'")
         pp_attn = make_seq_attn(seq_axis)
         # PP×SP×MoE: each tick's MoE calls see one microbatch's SLICE
         # of one seq shard; averaging the routing stats over the seq
@@ -306,6 +339,11 @@ def _transformer(cfg: ModelConfig) -> Model:
         if expert_axis is not None and not moe:
             raise ValueError("mesh has expert parallelism but the model has "
                              "no experts (model.num_experts == 0)")
+        if cfg.remat and cfg.remat_policy != "full":
+            raise ValueError(
+                f"model.remat_policy={cfg.remat_policy!r} is not "
+                "supported under the 1f1b schedule (chunk recompute is "
+                "built into the engine); set remat_policy='full'")
         if seq_axis is not None and cfg.sp_attention == "ring":
             raise ValueError(
                 "pipeline_schedule='1f1b' with sequence parallelism "

@@ -89,3 +89,100 @@ def test_remat_matches_dense_exactly(topo8):
     for a, b in zip(results[False][1], results[True][1]):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-6, atol=1e-7)
+
+
+def test_remat_save_attn_matches_full(topo8):
+    """remat_policy='save_attn' (attention residuals resident, FFN
+    recomputed) is the same math as full remat — loss and one-step
+    update must agree to float tolerance, through the flash kernel
+    whose custom-vjp residuals the policy keeps."""
+    import jax
+    import numpy as np
+
+    from conftest import base_config
+    from distributedmnist_tpu.models.registry import get_model
+    from distributedmnist_tpu.parallel.api import (build_train_step,
+                                                   init_train_state)
+    from distributedmnist_tpu.train.lr_schedule import constant
+
+    results = {}
+    for policy in ("full", "save_attn"):
+        cfg = base_config(
+            data={"dataset": "synthetic_lm", "batch_size": 8},
+            model={"name": "transformer", "compute_dtype": "float32",
+                   "seq_len": 16, "model_dim": 32, "num_heads": 4,
+                   "num_layers": 2, "vocab_size": 37,
+                   "attention_impl": "flash", "remat": True,
+                   "remat_policy": policy},
+            sync={"mode": "sync", "straggler_profile": "none"},
+        )
+        cfg = cfg.override({"mesh.num_replicas": 8})
+        model = get_model(cfg.model)
+        state = topo8.device_put_replicated(init_train_state(model, cfg))
+        step_fn = build_train_step(model, cfg, topo8, constant(0.1))
+        toks = jax.random.randint(jax.random.PRNGKey(3), (8, 16), 0, 37)
+        state, metrics = step_fn(
+            state, topo8.device_put_batch({"image": toks, "label": toks}))
+        results[policy] = (float(metrics["loss"]),
+                           jax.tree.leaves(jax.device_get(state.params)))
+    np.testing.assert_allclose(results["full"][0], results["save_attn"][0],
+                               rtol=1e-6)
+    for a, b in zip(results["full"][1], results["save_attn"][1]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_remat_save_attn_refuses_ring_sp(topo8):
+    """Ring attention has no fused VJP — outside a checkpoint AD would
+    save its per-step scan residuals, the memory remat exists to avoid.
+    The registry must refuse the combination loudly."""
+    import pytest
+
+    from conftest import base_config
+    from distributedmnist_tpu.models.registry import get_model
+    from distributedmnist_tpu.parallel.api import (build_train_step,
+                                                   init_train_state)
+    from distributedmnist_tpu.train.lr_schedule import constant
+
+    cfg = base_config(
+        data={"dataset": "synthetic_lm", "batch_size": 8},
+        model={"name": "transformer", "compute_dtype": "float32",
+               "seq_len": 16, "model_dim": 32, "num_heads": 4,
+               "num_layers": 2, "vocab_size": 37,
+               "attention_impl": "flash", "sp_attention": "ring",
+               "remat": True, "remat_policy": "save_attn"},
+        sync={"mode": "sync", "straggler_profile": "none"},
+    )
+    from distributedmnist_tpu.core.config import MeshConfig
+    from distributedmnist_tpu.core.mesh import make_topology
+
+    cfg = cfg.override({"mesh.num_replicas": 4, "mesh.seq_parallelism": 2})
+    topo = make_topology(MeshConfig(num_replicas=4, seq_parallelism=2))
+    model = get_model(cfg.model)
+    with pytest.raises(ValueError, match="save_attn"):
+        build_train_step(model, cfg, topo, constant(0.1))
+
+    # dense attention has no fused VJP either — O(s²) residuals would
+    # stay resident; refused at model build
+    with pytest.raises(ValueError, match="flash"):
+        get_model(cfg.model.__class__(**{
+            **{f.name: getattr(cfg.model, f.name)
+               for f in __import__("dataclasses").fields(cfg.model)},
+            "attention_impl": "dense", "sp_attention": "ring"}))
+
+    # pipeline stage scans only support full per-layer remat — a
+    # silently-ignored policy must be refused, not degraded
+    cfg_pp = base_config(
+        data={"dataset": "synthetic_lm", "batch_size": 8},
+        model={"name": "transformer", "compute_dtype": "float32",
+               "seq_len": 16, "model_dim": 32, "num_heads": 4,
+               "num_layers": 2, "vocab_size": 37,
+               "attention_impl": "flash", "remat": True,
+               "remat_policy": "save_attn"},
+        sync={"mode": "sync", "straggler_profile": "none"},
+    ).override({"mesh.num_replicas": 4, "mesh.pipeline_parallelism": 2})
+    topo_pp = make_topology(MeshConfig(num_replicas=4,
+                                       pipeline_parallelism=2))
+    model_pp = get_model(cfg_pp.model)
+    with pytest.raises(ValueError, match="remat_policy"):
+        build_train_step(model_pp, cfg_pp, topo_pp, constant(0.1))
