@@ -494,8 +494,9 @@ def bench_native_loader() -> None:
                       "host_cpu_count": os.cpu_count(),
                       "backend": jax.default_backend(),
                       "idx_decode": decode,
-                      "idx_decode_production_path": "python (faster; "
-                      "native reader kept for C-ABI tests)"}})
+                      "idx_decode_production_path": "python (default: parity "
+                      "within noise, no native-build dependency; native "
+                      "reader kept for C-ABI tests)"}})
 
 
 def main() -> None:

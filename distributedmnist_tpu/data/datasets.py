@@ -89,14 +89,14 @@ def _open_maybe_gz(path: Path):
 def _read_idx_ubyte(path: Path, expect_ndim: int) -> np.ndarray:
     """Raw idx(.gz) ubyte payload.
 
-    The numpy path is the DEFAULT decode: measured on the bench shape
-    (60k-image idx3.gz) it runs ~146 MB/s vs the C++ reader's ~130 —
-    both are zlib-inflate-bound, and the native path pays an extra
-    buffer copy crossing the ctypes boundary
-    (native_loader.read_idx's .copy()). The native reader stays
+    The numpy path is the DEFAULT decode. Repeated bench_native_loader
+    idx_decode runs on the 60k-image idx3.gz put the two readers within
+    run-to-run noise of each other (native 130-157 MB/s vs numpy
+    136-151 — both zlib-inflate-bound); numpy avoids the extra ctypes
+    boundary copy (native_loader.read_idx's .copy()) and any dependence
+    on the C++ build, so it wins the default. The native reader stays
     available for the C-ABI round-trip tests and any caller that wants
-    decode off the Python heap; it is not the production decode path
-    because it measures slower (bench_native_loader idx_decode)."""
+    decode off the Python heap."""
     with _open_maybe_gz(path) as f:
         magic = struct.unpack(">HBB", f.read(4))
         if magic[0] != 0 or magic[1] != 0x08:
