@@ -109,6 +109,18 @@ OVERRIDES = {
     # timing samples per replica, plenty for the percentile curves
     **{f"cdf50_{p}": {"train.max_steps": 100}
        for p in ("uniform", "lognormal_mild", "lognormal_heavy", "spike")},
+    # Interval sweep: UPDATE-count-matched step budgets. The configs
+    # keep the reference's fixed 300-iteration benchmark convention
+    # (tools/benchmark.py:265 n_iters), but a fixed step count gives
+    # slower pacings fewer applied updates (300 steps at the modeled
+    # ~840 ms step = 84 updates at 3000 ms but only 36 at 7000 ms), so
+    # a final-accuracy column misreads as "slower pacing is broken".
+    # steps ∝ interval_ms equalizes applied updates (measured 681-746
+    # across the sweep — the ~680 count long/interval_long converged
+    # at), so the sweep's accuracy column compares pacings at equal,
+    # convergence-sufficient update budgets.
+    **{f"interval_{ms}ms": {"train.max_steps": 800 * ms // 1000}
+       for ms in (3000, 4000, 5000, 6000, 7000)},
 }
 
 EVALUATED_RUN = "quorum_k8_of_8"  # kept for callers that import it
@@ -143,6 +155,16 @@ def run_group(group: str, names: list[str], results_dir: Path,
             if quick:
                 ov["train.max_steps"] = 20
             cfg = cfg.override(ov)
+            # Campaign semantics are RUN, not resume: a leftover train
+            # dir (aborted attempt, or a re-run with a raised step
+            # budget) would silently resume from its checkpoint and
+            # produce a spliced record whose timing arrays and wall
+            # clock cover only the post-resume tail — measured once:
+            # two of five interval rows shipped with '—' timing
+            # columns before this wipe existed. History lives in
+            # sweep_results.jsonl, not in the run dir.
+            import shutil
+            shutil.rmtree(gdir / name, ignore_errors=True)
             ev = None
             if name in EVALUATED_RUNS and not quick:
                 ev = start_evaluator(gdir / name)
@@ -232,10 +254,12 @@ def prune_heavy_artifacts(results_dir: Path) -> None:
 # proof run actually exists in the same results dir.
 SUMMARY_NOTES = {
     ("interval", "interval_long"): (
-        "accuracies are NOT converged by design: the fixed 300-step "
-        "budget yields only 39-84 applied updates, enough to rank the "
-        "pacings. Convergence proof: long/interval_long (same 3000 ms "
-        "pacing, 681 applied updates, test_accuracy 1.0)."),
+        "budgets are update-count-matched: steps scale with interval_ms "
+        "(campaign OVERRIDES) so every pacing applies ~680-750 updates "
+        "— the count long/interval_long converged at — and the accuracy "
+        "column compares pacings at equal, convergence-sufficient "
+        "update budgets rather than penalizing slow pacings for a "
+        "fixed step count."),
     ("cdf50", "cdf50_long"): (
         "accuracies are a 100-step-budget artifact: this grid measures "
         "barrier timing, not convergence. Convergence proof: "
