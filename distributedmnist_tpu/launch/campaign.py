@@ -155,16 +155,12 @@ def run_group(group: str, names: list[str], results_dir: Path,
             if quick:
                 ov["train.max_steps"] = 20
             cfg = cfg.override(ov)
-            # Campaign semantics are RUN, not resume: a leftover train
-            # dir (aborted attempt, or a re-run with a raised step
-            # budget) would silently resume from its checkpoint and
-            # produce a spliced record whose timing arrays and wall
-            # clock cover only the post-resume tail — measured once:
-            # two of five interval rows shipped with '—' timing
-            # columns before this wipe existed. History lives in
-            # sweep_results.jsonl, not in the run dir.
-            import shutil
-            shutil.rmtree(gdir / name, ignore_errors=True)
+            # Campaign semantics are RUN, not resume —
+            # run_experiment's fresh default (train.resume=False)
+            # guarantees it without deleting the previous artifacts
+            # up front (a pre-run wipe would destroy the committed
+            # evidence of a multi-hour run if the replacement crashed
+            # mid-flight). History lives in sweep_results.jsonl.
             ev = None
             if name in EVALUATED_RUNS and not quick:
                 ev = start_evaluator(gdir / name)

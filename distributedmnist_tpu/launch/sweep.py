@@ -27,9 +27,17 @@ logger = get_logger("sweep")
 
 
 def run_experiment(cfg: ExperimentConfig, results_dir: str | Path,
-                   datasets=None) -> dict[str, Any]:
+                   datasets=None, fresh: bool = True) -> dict[str, Any]:
     """Run one experiment to max_steps; return (and persist) a result
     record: final metrics, eval accuracy, step-time CDF stats.
+
+    ``fresh`` (default): force ``train.resume=False`` so a leftover
+    checkpoint in the run dir (an aborted attempt, or a re-run with a
+    raised step budget) can't splice the record — a silent resume
+    reports ``steps`` = final step while ``wall_seconds`` and the
+    timing arrays cover only the post-resume tail (measured: two
+    interval-sweep rows shipped with '—' timing columns that way).
+    Pass ``fresh=False`` only for a deliberately resumable long run.
 
     ≙ run_tf_and_download_files + stats parsing
     (tools/benchmark.py:36-163) collapsed into a function call.
@@ -44,6 +52,8 @@ def run_experiment(cfg: ExperimentConfig, results_dir: str | Path,
     results_dir = Path(results_dir) / cfg.name
     results_dir.mkdir(parents=True, exist_ok=True)
     cfg = cfg.override({"train.train_dir": str(results_dir / "train")})
+    if fresh:
+        cfg = cfg.override({"train.resume": False})
     cfg.save(results_dir / "config.json")
 
     t0 = time.time()

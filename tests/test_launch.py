@@ -21,6 +21,27 @@ def test_run_experiment_produces_record(tmp_path, synthetic_datasets):
     assert (tmp_path / "exp_sync" / "config.json").exists()
 
 
+def test_run_experiment_is_fresh_not_resumed(tmp_path, synthetic_datasets):
+    """A re-run into an existing results dir must train from step 0,
+    not silently resume from the previous attempt's checkpoint: a
+    resume reports steps=final_step while the timing arrays cover only
+    the post-resume tail (two interval-sweep rows shipped that way).
+    ``steps == timing.num_steps`` is the consistency invariant."""
+    from distributedmnist_tpu.launch.sweep import run_experiment
+    cfg = base_config(name="fresh_check",
+                      train={"max_steps": 6, "log_every_steps": 3,
+                             "save_interval_steps": 3})
+    first = run_experiment(cfg, tmp_path, datasets=synthetic_datasets)
+    assert first["steps"] == first["timing"]["num_steps"] == 6
+    # second run with a RAISED budget over the same dir (the leftover
+    # step-6 checkpoint is the trap)
+    cfg2 = base_config(name="fresh_check",
+                       train={"max_steps": 10, "log_every_steps": 5,
+                              "save_interval_steps": 5})
+    rec = run_experiment(cfg2, tmp_path, datasets=synthetic_datasets)
+    assert rec["steps"] == rec["timing"]["num_steps"] == 10
+
+
 def test_run_sweep_report(tmp_path, synthetic_datasets):
     from distributedmnist_tpu.launch.sweep import run_sweep
     cfgs = [base_config(name=f"s{k}",
