@@ -1,26 +1,35 @@
 #!/usr/bin/env python
-"""Benchmark entry point — prints ONE JSON line on stdout:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""Benchmark entry point — prints ONE self-contained JSON line on
+stdout, LAST:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+   "cases": [<every additional case record>]}
 
-The headline metric is steady-state training throughput (images/sec)
-of the flagship MNIST CNN under sync-replica SGD semantics on whatever
-devices are visible (one TPU chip under the driver; the virtual CPU
-mesh works too). ``vs_baseline`` ratchets against the round-1 number
-recorded in BASELINE.json.published — a regression shows up as < 1.0,
-not as a silent 1.0.
+The top-level metric is the headline: steady-state training throughput
+(images/sec) of the flagship MNIST CNN under sync-replica SGD
+semantics on whatever devices are visible (one TPU chip under the
+driver; the virtual CPU mesh works too). ``vs_baseline`` ratchets
+against the round-1 number recorded in BASELINE.json.published — a
+regression shows up as < 1.0, not as a silent 1.0.
 
-Additional cases go to stderr as their own JSON lines (the stdout
-contract stays one line):
+``cases`` carries the rest, so the artifact is verifiable from the one
+stdout line alone (VERDICT weak #2: the old layout printed the
+headline first and cases on stderr, and the driver's last-bytes
+capture lost the headline entirely):
   * transformer+flash-attention train step, model TFLOP/s
-  * quorum / cdf aggregation-discipline overhead vs plain sync
-    (SURVEY §7: timing capture must not cost scaling efficiency)
+  * quorum / cdf aggregation-discipline overhead vs plain sync,
+    median-gated over interleaved repeats (SURVEY §7: timing capture
+    must not cost scaling efficiency)
   * native C++ prefetch loader vs the pure-python batch pipeline
+
+Per-case records still stream to stderr as they complete (progress for
+a human following the run); stdout is reserved for the final artifact.
 
 The reference publishes no numbers (README.md:1 is bare — SURVEY §6);
 the baseline is this repo's own round-1 measurement.
 """
 
 import json
+import statistics
 import sys
 import time
 
@@ -75,9 +84,10 @@ def _env_stamp() -> dict:
             "jax_version": jax.__version__}
 
 
-def _scan_chunks(step_fn, state, gbatch, chunk_len: int, n_chunks: int):
-    """Time ``n_chunks`` dispatches of an ON-DEVICE ``lax.scan`` of
-    ``chunk_len`` training steps each.
+class _ChunkTimer:
+    """Persistent jitted runner for an ON-DEVICE ``lax.scan`` of
+    ``chunk_len`` training steps: compile + warm ONCE, then
+    :meth:`measure` any number of times.
 
     The round-3 driver capture showed per-step wall times ~18x the
     in-session steady state; with one host dispatch per step, the
@@ -89,45 +99,67 @@ def _scan_chunks(step_fn, state, gbatch, chunk_len: int, n_chunks: int):
     state throughput the reference reports from in-run step timing
     (src/distributed_train.py:365-371).
 
+    Persistence is what makes interleaved-repeat gates affordable
+    (VERDICT weak #1): re-measuring a mode costs only its timed chunks,
+    not a recompile, so sync/quorum/cdf can alternate on the same chip
+    and drift lands on every mode equally.
+    """
+
+    def __init__(self, step_fn, state, gbatch, chunk_len: int):
+        def chunk(st, batch):
+            def body(carry, _):
+                new_state, metrics = step_fn(carry, batch)
+                return new_state, metrics["loss"]
+            final, losses = lax.scan(body, st, None, length=chunk_len)
+            return final, losses[-1]
+
+        self.chunk_len = chunk_len
+        self._gbatch = gbatch
+        self._run = jax.jit(chunk, donate_argnums=0)
+        t0 = time.perf_counter()
+        state, loss = self._run(state, gbatch)
+        float(loss)  # drain (see _drain)
+        self.compile_s = time.perf_counter() - t0
+        # One untimed warm chunk: the first post-compile dispatch pays
+        # a host/tunnel ramp (measured 4-14 ms/step of pure jitter at
+        # the flash shape — two runs of identical code differed only
+        # there). Steady-state device throughput is the quantity every
+        # case reports; the warm chunk is excluded from the timed
+        # window uniformly, and per_step_ms_by_chunk shows the spread.
+        state, loss = self._run(state, gbatch)
+        float(loss)
+        self.state = state
+
+    def measure(self, n_chunks: int) -> list[float]:
+        """Per-chunk wall seconds for ``n_chunks`` timed chunks.
+
+        Dispatch every chunk before fetching any: the device queue runs
+        the chunks back-to-back while the ~70 ms tunnel relay of each
+        fetch overlaps the next chunk's compute, so exactly ONE relay
+        latency lands in the timed window instead of one per chunk.
+        """
+        losses = []
+        t0 = time.perf_counter()
+        for _ in range(n_chunks):
+            self.state, loss = self._run(self.state, self._gbatch)
+            losses.append(loss)
+        times, prev = [], t0
+        for loss in losses:
+            float(loss)  # returns when that chunk has drained
+            now = time.perf_counter()
+            times.append(now - prev)
+            prev = now
+        return times
+
+
+def _scan_chunks(step_fn, state, gbatch, chunk_len: int, n_chunks: int):
+    """One-shot compile → warm → time ``n_chunks`` chunks.
+
     Returns (chunk_seconds list, compile_seconds, final_state).
     """
-    def chunk(st, batch):
-        def body(carry, _):
-            new_state, metrics = step_fn(carry, batch)
-            return new_state, metrics["loss"]
-        final, losses = lax.scan(body, st, None, length=chunk_len)
-        return final, losses[-1]
-
-    run = jax.jit(chunk, donate_argnums=0)
-    t0 = time.perf_counter()
-    state, loss = run(state, gbatch)
-    float(loss)  # drain (see _drain)
-    compile_s = time.perf_counter() - t0
-    # One untimed warm chunk: the first post-compile dispatch pays a
-    # host/tunnel ramp (measured 4-14 ms/step of pure jitter at the
-    # flash shape — two runs of identical code differed only there).
-    # Steady-state device throughput is the quantity every case
-    # reports; the warm chunk is excluded from the timed window
-    # uniformly, and per_step_ms_by_chunk still shows the spread.
-    state, loss = run(state, gbatch)
-    float(loss)
-
-    # Dispatch every chunk before fetching any: the device queue runs
-    # the chunks back-to-back while the ~70 ms tunnel relay of each
-    # fetch overlaps the next chunk's compute, so exactly ONE relay
-    # latency lands in the timed window instead of one per chunk.
-    losses = []
-    t0 = time.perf_counter()
-    for _ in range(n_chunks):
-        state, loss = run(state, gbatch)
-        losses.append(loss)
-    times, prev = [], t0
-    for loss in losses:
-        float(loss)  # returns when that chunk has drained
-        now = time.perf_counter()
-        times.append(now - prev)
-        prev = now
-    return times, compile_s, state
+    timer = _ChunkTimer(step_fn, state, gbatch, chunk_len)
+    times = timer.measure(n_chunks)
+    return times, timer.compile_s, timer.state
 
 
 def _build(cfg_dict: dict, topo=None):
@@ -191,7 +223,7 @@ def bench_cnn_sync() -> dict:
     return record
 
 
-def bench_transformer_flash() -> None:
+def bench_transformer_flash() -> dict:
     """Transformer with the Pallas flash-attention kernels (fwd+bwd):
     model TFLOP/s per chip — the committed artifact for the kernel
     path's performance claims."""
@@ -237,10 +269,10 @@ def bench_transformer_flash() -> None:
                          **_env_stamp()}}
     if vs is not None and vs < 0.5:
         record["degraded"] = True
-    _case(record)
+    return record
 
 
-def bench_flash_long_context() -> None:
+def bench_flash_long_context() -> dict:
     """Long-context case: flash attention at S=8192 on one chip, where
     the attention term (2·S·d per token per layer) rivals the matmul
     FLOPs — the regime ring/Ulysses SP extends across chips. Exercises
@@ -312,14 +344,24 @@ def bench_flash_long_context() -> None:
                   **_env_stamp()}}
     if vs is not None and vs < 0.5:
         record["degraded"] = True
-    _case(record)
+    return record
 
 
-def bench_mode_overhead() -> None:
+def bench_mode_overhead() -> list[dict]:
     """Aggregation-discipline tax: quorum and cdf modes vs plain sync
     on the same model/batch. The masks, timing model, rank reduction
     and [n]-vector gathers must stay within a 10% throughput budget
-    (SURVEY §7 'timing capture must not cost scaling efficiency')."""
+    (SURVEY §7 'timing capture must not cost scaling efficiency').
+
+    The gate is the MEDIAN over ≥3 INTERLEAVED repeats — one
+    sync/quorum/cdf rotation per repeat, so shared-chip drift hits all
+    modes alike and a single noisy window cannot flip the verdict
+    (VERDICT weak #1: round 5's 11.82% "failure" re-measured at -1.84%
+    the same day; history 0.14 → 2.95 → 11.82 → -1.84%). All repeats
+    land in the artifact. ≙ the stats discipline the reference applies
+    to worker step times, tools/benchmark.py:86-111, applied to the
+    harness itself.
+    """
     from distributedmnist_tpu.data.datasets import make_synthetic
 
     n_dev = len(jax.devices())
@@ -328,35 +370,53 @@ def bench_mode_overhead() -> None:
     host_batch = {"image": ds.train.images[:batch],
                   "label": ds.train.labels[:batch]}
 
-    def run(sync_cfg: dict) -> float:
+    k = max(1, n_dev - 1)
+    modes = {
+        "sync": {"mode": "sync"},
+        "quorum": {"mode": "quorum", "num_replicas_to_aggregate": k,
+                   "straggler_profile": "lognormal"},
+        "cdf": {"mode": "cdf"},
+    }
+    chunk_len, n_chunks, n_repeats = 20, 2, 3
+
+    timers: dict[str, _ChunkTimer] = {}
+    for name, sync_cfg in modes.items():
         cfg, topo, model, state, step_fn = _build({
             "data": {"dataset": "synthetic", "batch_size": batch},
             "model": {"compute_dtype": "bfloat16"},
             "sync": sync_cfg,
         })
         gbatch = topo.device_put_batch(host_batch)
-        chunk_len, n_chunks = 20, 3
-        times, _, _ = _scan_chunks(step_fn, state, gbatch,
-                                   chunk_len, n_chunks)
-        return chunk_len * n_chunks * batch / sum(times)
+        timers[name] = _ChunkTimer(step_fn, state, gbatch, chunk_len)
 
-    base = run({"mode": "sync"})
-    n = len(jax.devices())
-    k = max(1, n - 1)
-    for mode, sync_cfg in (
-            ("quorum", {"mode": "quorum", "num_replicas_to_aggregate": k,
-                        "straggler_profile": "lognormal"}),
-            ("cdf", {"mode": "cdf"})):
-        ips = run(sync_cfg)
-        overhead = (base - ips) / base
-        _case({"metric": f"{mode}_mode_overhead_vs_sync",
-               "value": round(overhead * 100, 2), "unit": "percent",
-               "within_10pct_budget": bool(overhead < 0.10),
-               "detail": {"sync_img_per_sec": round(base, 1),
-                          f"{mode}_img_per_sec": round(ips, 1)}})
+    rates: dict[str, list[float]] = {name: [] for name in modes}
+    for _ in range(n_repeats):
+        for name, timer in timers.items():  # one rotation per repeat
+            dt = sum(timer.measure(n_chunks))
+            rates[name].append(chunk_len * n_chunks * batch / dt)
+
+    med = {name: statistics.median(r) for name, r in rates.items()}
+    records = []
+    for mode in ("quorum", "cdf"):
+        by_repeat = [round((s - m) / s * 100, 2)
+                     for s, m in zip(rates["sync"], rates[mode])]
+        overhead = (med["sync"] - med[mode]) / med["sync"]
+        records.append({
+            "metric": f"{mode}_mode_overhead_vs_sync",
+            "value": round(overhead * 100, 2), "unit": "percent",
+            "within_10pct_budget": bool(overhead < 0.10),
+            "detail": {
+                "gate": f"median of {n_repeats} interleaved repeats",
+                "overhead_pct_by_repeat": by_repeat,
+                "sync_img_per_sec_median": round(med["sync"], 1),
+                f"{mode}_img_per_sec_median": round(med[mode], 1),
+                "img_per_sec_by_repeat": {
+                    "sync": [round(r, 1) for r in rates["sync"]],
+                    mode: [round(r, 1) for r in rates[mode]]}}})
+    return records
 
 
-def bench_native_loader() -> None:
+def bench_native_loader() -> dict:
     """Native C++ data path vs pure python, measured at its two real
     jobs: (a) cold idx decode throughput (gunzip + parse — what the C++
     decoder exists for), and (b) steady-state pipeline rate with an
@@ -475,7 +535,7 @@ def bench_native_loader() -> None:
 
     prod_ratio = ratio("device_blocked")
     native = rates.get("device_blocked_native")
-    _case({"metric": "native_loader_overlapped_batches_per_sec",
+    return ({"metric": "native_loader_overlapped_batches_per_sec",
            "value": round(native, 1) if native else None,
            "unit": "batches/sec",
            "detail": {"prefetch_depth_production": prod_depth,
@@ -500,15 +560,25 @@ def bench_native_loader() -> None:
 
 
 def main() -> None:
+    """Run every case, then print the ONE self-contained artifact line
+    on stdout, LAST — the driver keeps the tail of the output, so
+    last-wins is what makes the artifact survive capture (VERDICT weak
+    #2: headline-first + cases-on-stderr lost the cnn headline)."""
     headline = bench_cnn_sync()
-    print(json.dumps(headline))
-    sys.stdout.flush()
+    _case(headline)  # stderr progress; stdout stays reserved for the end
+    cases: list[dict] = []
     for case in (bench_transformer_flash, bench_flash_long_context,
                  bench_mode_overhead, bench_native_loader):
         try:
-            case()
+            got = case()
         except Exception as e:  # a failed case must not kill the headline
-            _case({"metric": case.__name__, "error": f"{type(e).__name__}: {e}"})
+            got = {"metric": case.__name__,
+                   "error": f"{type(e).__name__}: {e}"}
+        for record in got if isinstance(got, list) else [got]:
+            _case(record)
+            cases.append(record)
+    print(json.dumps({**headline, "cases": cases},
+                     separators=(",", ":")))
 
 
 if __name__ == "__main__":

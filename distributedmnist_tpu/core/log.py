@@ -64,6 +64,16 @@ def _default(o: Any):
     return str(o)
 
 
+def text_tail(s: str | None, limit: int = 2000) -> str | None:
+    """Last ``limit`` characters of ``s`` — the journal-friendly form of
+    a subprocess stream (a crashing worker's last lines are the
+    diagnostic ones; the driver that reads these artifacts keeps tails,
+    not heads)."""
+    if s is None:
+        return None
+    return s if len(s) <= limit else s[-limit:]
+
+
 def step_line(replica: int, step: int, loss: float, train_acc: float,
               examples_per_sec: float, sec_per_batch: float) -> str:
     """The canonical per-step record (≙ src/distributed_train.py:367-371)."""

@@ -27,6 +27,40 @@ from .config import MeshConfig
 P = PartitionSpec
 
 
+if not hasattr(jax, "shard_map"):
+    # jax < 0.4.38 ships shard_map only under jax.experimental; alias it
+    # so the package (and tests) use one spelling on every jax this repo
+    # runs against. The call shape (f, mesh=, in_specs=, out_specs=) is
+    # identical. check_rep off: this jax predates the vma/pcast marker
+    # API the kernels use to satisfy the replication checker, so the
+    # checker cannot be satisfied — the markers become no-ops below.
+    from jax.experimental.shard_map import shard_map as _experimental_sm
+
+    def _shard_map_compat(f, *, mesh, in_specs, out_specs):
+        return _experimental_sm(f, mesh=mesh, in_specs=in_specs,
+                                out_specs=out_specs, check_rep=False)
+
+    jax.shard_map = _shard_map_compat
+if not hasattr(jax.lax, "pcast"):
+    # the replication→varying marker is purely a check_vma annotation;
+    # with the checker off (above) the identity is semantically exact
+    jax.lax.pcast = lambda x, axes, *, to="varying": x
+if not hasattr(jax.lax, "axis_size"):
+    # psum of the literal 1 constant-folds to the concrete axis size on
+    # every jax this repo supports — the pre-0.4.38 spelling
+    jax.lax.axis_size = lambda name: jax.lax.psum(1, name)
+if not hasattr(jax, "typeof"):
+    # jax.typeof is get_aval with vma metadata; callers here only read
+    # `.vma` through getattr(..., frozenset()) so the plain aval works
+    jax.typeof = lambda x: jax.core.get_aval(x)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    """Version-portable ``shard_map`` (see the alias install above)."""
+    return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs)
+
+
 def initialize_distributed() -> None:
     """Multi-host bring-up (≙ tf.train.Server + startup barrier,
     src/mnist_distributed_train.py:27-35, src/timeout_manager.py:198-211).
@@ -49,6 +83,15 @@ def initialize_distributed() -> None:
         or len([h for h in hostnames.split(",") if h]) > 1)
     if not multi_host_hint:
         return  # single-process run (one chip / CPU simulation)
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        # multi-process CPU needs the gloo collectives backend; on jax
+        # < 0.5 the default ("none") makes every collective raise
+        # "Multiprocess computations aren't implemented on the CPU
+        # backend". Newer jax defaults to gloo and may drop the knob.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except AttributeError:
+            pass
     if explicit and ("JAX_NUM_PROCESSES" in os.environ
                      or "JAX_PROCESS_ID" in os.environ):
         # Generic-cluster bring-up (≙ the reference's explicit
@@ -114,6 +157,8 @@ def simulate_devices(n: int) -> None:
         jax.config.update("jax_num_cpu_devices", n)
     except RuntimeError:
         pass  # backend already initialized; XLA_FLAGS path applies
+    except AttributeError:
+        pass  # jax < 0.4.38 has no jax_num_cpu_devices; XLA_FLAGS applies
 
 
 def strip_forced_platform_env(env: dict) -> dict:

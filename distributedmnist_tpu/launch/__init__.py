@@ -1,3 +1,13 @@
+from .cluster import (ClusterBackend, ClusterError, GcloudTpuBackend,
+                      LocalClusterConfig, LocalProcessCluster, run_until_step,
+                      wait_until_step)
+from .exec import (BinaryNotFoundError, CommandExecutor, ExecError,
+                   ExecResult, FaultPlan, RetryPolicy)
 from .sweep import load_sweep_configs, run_experiment, run_sweep, write_report
 
-__all__ = ["load_sweep_configs", "run_experiment", "run_sweep", "write_report"]
+__all__ = ["BinaryNotFoundError", "ClusterBackend", "ClusterError",
+           "CommandExecutor", "ExecError",
+           "ExecResult", "FaultPlan", "GcloudTpuBackend", "LocalClusterConfig",
+           "LocalProcessCluster", "RetryPolicy", "load_sweep_configs",
+           "run_experiment", "run_sweep", "run_until_step",
+           "wait_until_step", "write_report"]

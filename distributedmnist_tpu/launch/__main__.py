@@ -8,6 +8,7 @@ host runs the same program — so the CLI reduces to:
   python -m distributedmnist_tpu.launch train --config cfg.json [k=v ...]
   python -m distributedmnist_tpu.launch eval  --train_dir DIR
   python -m distributedmnist_tpu.launch sweep --configs DIR --results DIR
+  python -m distributedmnist_tpu.launch cluster run --until-step N [--backend local]
   python -m distributedmnist_tpu.launch report --train_dir DIR --out DIR
   python -m distributedmnist_tpu.launch devices
 
@@ -380,6 +381,18 @@ def main(argv=None) -> None:
                         add_help=False)
     pp.add_argument("rest", nargs=argparse.REMAINDER)
     pp.set_defaults(fn=_pod)
+
+    def _cluster(args) -> None:
+        from .cluster import main as cluster_main
+        cluster_main(args.rest)
+
+    pc = sub.add_parser(
+        "cluster", help="backend-pluggable cluster lifecycle "
+                        "(local process-cluster or gcloud TPU-VM; "
+                        "fault plans, command journal)",
+        add_help=False)
+    pc.add_argument("rest", nargs=argparse.REMAINDER)
+    pc.set_defaults(fn=_cluster)
 
     sub.add_parser("campaign",
                    help="run the full experiment campaign grid "

@@ -1,0 +1,618 @@
+"""Pluggable cluster backends beneath one launcher.
+
+≙ the reference's orchestrator, split the way TF-Replicator
+(arXiv:1902.00465) splits it: one user-facing lifecycle surface, N
+backend realizations. ``tools/tf_ec2.py`` fused "what a cluster is"
+(EC2 spot instances, :237-271) with "how to drive one" (parallel SSH
+fan-out, :536-569) into one file; here :class:`ClusterBackend` is the
+contract — create / delete / status / run_train / kill_all / exec_all
+/ download / poll — and two backends realize it:
+
+* :class:`GcloudTpuBackend` — the gcloud TPU-VM argv builders
+  refactored out of ``launch/pod.py`` (argv unchanged; ``PodManager``
+  now delegates here).
+* :class:`LocalProcessCluster` — the same lifecycle as REAL local
+  subprocesses: N worker processes running ``launch train`` under
+  ``JAX_PLATFORMS=cpu``, per-worker logdirs, a pgrep-equivalent
+  ``status()`` probe, file-copy ``download``. Every verb executes as
+  an actual subprocess through :class:`~.exec.CommandExecutor`, so
+  ``create → run → poll --until-step → download → delete`` runs
+  end-to-end on this box and leaves a JSONL command journal.
+
+The module-level :func:`wait_until_step` / :func:`run_until_step`
+drivers (≙ tools/benchmark.py:24-44 launch → poll ssh'd log → kill at
+step N) are generic over backends — the fault-injected lifecycle tests
+drive them against real processes.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import json
+import os
+import shlex
+import subprocess
+import time
+from pathlib import Path
+from typing import Any
+
+from ..core.log import get_logger
+from .exec import CommandExecutor, ExecError, FaultPlan, RetryPolicy
+
+logger = get_logger("cluster")
+
+
+class ClusterError(RuntimeError):
+    pass
+
+
+def parse_poll_output(text: str | None) -> dict[str, Any]:
+    """Parse the tail of a ``train_log.jsonl`` into {"step", "record"}.
+
+    step is -1 when the log does not exist yet (run still booting) or
+    the last line is a torn write — the next poll resolves it.
+    """
+    lines = (text or "").strip().splitlines()
+    if not lines:
+        return {"step": -1, "record": None}
+    try:
+        record = json.loads(lines[-1])
+    except json.JSONDecodeError:
+        return {"step": -1, "record": None}
+    return {"step": int(record.get("step", -1)), "record": record}
+
+
+class ClusterBackend(abc.ABC):
+    """The lifecycle contract every backend realizes (≙ the reference's
+    11-subcommand dispatch, tools/tf_ec2.py:828-856, as an interface)."""
+
+    @abc.abstractmethod
+    def create(self) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, ignore_missing: bool = False) -> None:
+        """Tear the cluster down. ``ignore_missing``: deleting a
+        cluster that does not exist is not an error (the
+        delete-if-exists step of clean-launch-run)."""
+
+    @abc.abstractmethod
+    def status(self) -> dict[str, Any] | None: ...
+
+    @abc.abstractmethod
+    def run_train(self) -> None: ...
+
+    @abc.abstractmethod
+    def kill_all(self, worker: str = "all") -> None: ...
+
+    @abc.abstractmethod
+    def exec_all(self, command: str, worker: str = "all") -> None: ...
+
+    @abc.abstractmethod
+    def download(self, local_dir: str | Path,
+                 remote_path: str | None = None,
+                 worker: str = "0") -> None: ...
+
+    @abc.abstractmethod
+    def poll(self) -> dict[str, Any] | None: ...
+
+
+# ---------------------------------------------------------------------------
+# generic lifecycle drivers (backend-agnostic)
+# ---------------------------------------------------------------------------
+
+def wait_until_step(backend: ClusterBackend, target: int,
+                    poll_secs: float = 30.0,
+                    timeout_secs: float = 24 * 3600.0) -> dict[str, Any]:
+    """Block until the cluster's run reaches ``target`` steps
+    (≙ benchmark.py's run-until-step-N loop :24-34). Dry-run backends
+    record exactly one poll argv and return immediately."""
+    deadline = time.monotonic() + timeout_secs
+    while True:
+        got = backend.poll()
+        if got is None:  # dry-run
+            return {"step": target, "record": None, "dry_run": True}
+        if got["step"] >= target:
+            return got
+        if got.get("workers_alive") == 0:
+            # every worker is gone and the log never reached the target
+            # — a crashed cluster must fail now, not at the poll timeout
+            # (backends that can't count workers omit the key)
+            raise ClusterError(
+                f"no live workers and the run stopped at step "
+                f"{got['step']} < {target}")
+        if time.monotonic() >= deadline:
+            raise ClusterError(
+                f"run did not reach step {target} within "
+                f"{timeout_secs:.0f}s (last seen: {got['step']})")
+        logger.info("step %d/%d — next poll in %.0fs",
+                    got["step"], target, poll_secs)
+        time.sleep(poll_secs)
+
+
+def run_until_step(backend: ClusterBackend, target: int,
+                   poll_secs: float = 30.0,
+                   timeout_secs: float = 24 * 3600.0) -> dict[str, Any]:
+    """Launch training, follow the log to step ``target``, then stop
+    the run — on EVERY exit path: a poll timeout or a Ctrl-C must not
+    leave the cluster training (and, on cloud backends, billing)."""
+    backend.run_train()
+    try:
+        return wait_until_step(backend, target, poll_secs, timeout_secs)
+    finally:
+        backend.kill_all()
+
+
+# ---------------------------------------------------------------------------
+# gcloud TPU-VM backend (argv builders refactored out of PodManager)
+# ---------------------------------------------------------------------------
+
+class GcloudTpuBackend(ClusterBackend):
+    """The Cloud TPU realization: one slice resource, SSH fan-out via
+    ``gcloud compute tpus tpu-vm ssh --worker=all``, scp downloads.
+    ``cfg`` is a :class:`~.pod.PodConfig`; ``runner`` any executor with
+    a ``run(argv, check=..., capture=..., verb=...)`` method (the
+    ``pod.Runner`` compat shim or a bare :class:`CommandExecutor`)."""
+
+    def __init__(self, cfg, runner):
+        self.cfg = cfg
+        self.runner = runner
+
+    # -- argv builders (pure) -------------------------------------------
+
+    def _base(self, *verb: str) -> list[str]:
+        argv = ["gcloud", "compute", "tpus", "tpu-vm", *verb, self.cfg.name,
+                "--zone", self.cfg.zone]
+        if self.cfg.project:
+            argv += ["--project", self.cfg.project]
+        return argv
+
+    def _ssh(self, command: str, worker: str = "all") -> list[str]:
+        exports = "".join(f"export {k}={shlex.quote(v)}; "
+                          for k, v in self.cfg.env.items())
+        return self._base("ssh") + ["--worker", worker,
+                                    "--command", exports + command]
+
+    # -- lifecycle ------------------------------------------------------
+
+    def create(self) -> None:
+        """≙ launch (tf_ec2.py:796): create the slice, run setup."""
+        argv = self._base("create") + [
+            "--accelerator-type", self.cfg.accelerator_type,
+            "--version", self.cfg.runtime_version]
+        if self.cfg.spot:
+            argv.append("--spot")
+        self.runner.run(argv, verb="create")
+        if self.cfg.setup_command:
+            self.runner.run(self._ssh(self.cfg.setup_command), verb="exec")
+
+    def delete(self, ignore_missing: bool = False) -> None:
+        """≙ shutdown (tf_ec2.py:440)."""
+        self.runner.run(self._base("delete") + ["--quiet"], verb="delete",
+                        check=not ignore_missing)
+
+    def status(self) -> dict[str, Any] | None:
+        """≙ list_running/list_idle (tf_ec2.py:371-404): slice state
+        plus whether python is running on any worker."""
+        out = self.runner.run(self._base("describe") + ["--format", "json"],
+                              capture=True, verb="status")
+        # [d]… so the pattern never matches the ssh-spawned shell whose
+        # own command line contains it (pgrep -f excludes only itself).
+        probe = self.runner.run(
+            self._ssh("pgrep -c -f '[d]istributedmnist_tpu.launch' || true"),
+            capture=True, check=False, verb="status")
+        if out is None:  # dry-run: both argvs recorded above, no result
+            return None
+        desc = json.loads(out.stdout)
+        if probe is None or probe.returncode != 0:
+            idle = None  # probe failed — unknown, NOT "idle" (a caller
+            # keying deletion off idle must not kill a live run)
+        else:
+            idle = not any(line.strip() not in ("", "0")
+                           for line in (probe.stdout or "").splitlines())
+        return {"state": desc.get("state"), "idle": idle, "describe": desc}
+
+    # -- work -----------------------------------------------------------
+
+    def run_train(self) -> None:
+        """≙ run_tf (tf_ec2.py:445): same command on every worker —
+        jax.distributed discovers the slice topology; no role/host
+        templating exists."""
+        outdir = shlex.quote(self.cfg.remote_outdir)
+        log = shlex.quote(f"{self.cfg.remote_outdir}/train_stdout.log")
+        self.runner.run(self._ssh(
+            f"mkdir -p {outdir} && cd ~ && "
+            f"nohup {self.cfg.train_command} > {log} 2>&1 &"), verb="run")
+
+    def kill_all(self, worker: str = "all") -> None:
+        """≙ kill_all_python / kill_python (tf_ec2.py:617-649)."""
+        self.runner.run(self._ssh("pkill -9 -f python || true", worker=worker),
+                        check=False, verb="kill")
+
+    def exec_all(self, command: str, worker: str = "all") -> None:
+        """≙ run_command (tf_ec2.py:841)."""
+        self.runner.run(self._ssh(command, worker=worker), verb="exec")
+
+    def download(self, local_dir: str | Path, remote_path: str | None = None,
+                 worker: str = "0") -> None:
+        """≙ download_outdir / download_file (tf_ec2.py:651-742)."""
+        remote = remote_path or self.cfg.remote_outdir
+        local_dir = Path(local_dir)
+        local_dir.mkdir(parents=True, exist_ok=True)
+        # scp's positional is <name>:<path>, not a bare name, so the
+        # _base helper doesn't apply
+        argv = ["gcloud", "compute", "tpus", "tpu-vm", "scp",
+                "--zone", self.cfg.zone]
+        if self.cfg.project:
+            argv += ["--project", self.cfg.project]
+        argv += ["--worker", worker, "--recurse",
+                 f"{self.cfg.name}:{remote}", str(local_dir)]
+        self.runner.run(argv, verb="download")
+
+    def poll(self) -> dict[str, Any] | None:
+        """Tail worker 0's ``train_log.jsonl`` (every host logs the same
+        replicated metrics) and parse the newest record. ≙ the
+        reference's master-log poll (tools/benchmark.py:24-34), against
+        the structured log instead of a regex over freeform text."""
+        log = shlex.quote(f"{self.cfg.remote_outdir}/train_log.jsonl")
+        out = self.runner.run(
+            self._ssh(f"tail -n 1 {log} 2>/dev/null || true", worker="0"),
+            capture=True, check=False, verb="poll")
+        if out is None:
+            return None
+        return parse_poll_output(out.stdout)
+
+
+# ---------------------------------------------------------------------------
+# local process-cluster backend
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class LocalClusterConfig:
+    """Declarative local cluster description (the LocalProcessCluster
+    counterpart of ``PodConfig`` — same safe-JSON shape)."""
+
+    name: str = "dmt-local"
+    num_workers: int = 2
+    workdir: str = "/tmp/dmt_local_cluster"
+    setup_command: str = ""
+    # runs with cwd = the worker's logdir; `train.train_dir=.` makes the
+    # structured log land where status/poll/download expect it
+    train_command: str = (
+        "python -m distributedmnist_tpu.launch train "
+        "train.train_dir=. data.dataset=synthetic data.batch_size=32 "
+        "data.synthetic_train_size=256 data.synthetic_test_size=64 "
+        "model.compute_dtype=float32 train.max_steps=50 "
+        "train.log_every_steps=5 train.save_interval_steps=0")
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_file(cls, path: str | Path) -> "LocalClusterConfig":
+        d = json.loads(Path(path).read_text())
+        unknown = set(d) - {f.name for f in dataclasses.fields(cls)}
+        if unknown:
+            raise ClusterError(f"unknown cluster config keys: "
+                               f"{sorted(unknown)}")
+        return cls(**d)
+
+    @property
+    def root(self) -> Path:
+        return Path(self.workdir) / self.name
+
+    def worker_dir(self, k: int) -> Path:
+        return self.root / f"worker{k}"
+
+
+class LocalProcessCluster(ClusterBackend):
+    """The same lifecycle as real local subprocesses.
+
+    Each worker is an actual detached OS process running
+    ``cfg.train_command`` under ``JAX_PLATFORMS=cpu`` with cwd = its
+    own logdir; every other verb (pgrep-equivalent status probe, tail
+    poll, cp -r download, kill delete) executes as a real subprocess
+    through the :class:`CommandExecutor`, so the fault plan and the
+    command journal apply uniformly. The mock-free test realization of
+    the backend contract — and a usable N-process trainer on any box.
+    """
+
+    def __init__(self, cfg: LocalClusterConfig,
+                 executor: CommandExecutor | None = None):
+        self.cfg = cfg
+        self.exec = executor or CommandExecutor(
+            journal=self.cfg.root / "command_journal.jsonl",
+            retry=RetryPolicy(max_attempts=1))
+        self._fault_killed: set[int] = set()
+
+    # -- state file -----------------------------------------------------
+
+    @property
+    def state_path(self) -> Path:
+        return self.cfg.root / "state.json"
+
+    def _read_state(self) -> dict[str, Any]:
+        if self.exec.dry_run:
+            # dry-run writes no state file; synthesize the worker list
+            # from the config so every verb still records its argv
+            return {"phase": "dry-run",
+                    "workers": [{"worker": k, "pid": None,
+                                 "logdir": str(self.cfg.worker_dir(k))}
+                                for k in range(self.cfg.num_workers)]}
+        if not self.state_path.exists():
+            return {"phase": "absent", "workers": []}
+        return json.loads(self.state_path.read_text())
+
+    def _write_state(self, state: dict[str, Any]) -> None:
+        if self.exec.dry_run:
+            return  # dry-run records argv only — no on-disk mutation
+        self.state_path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = self.state_path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(state, indent=2))
+        tmp.replace(self.state_path)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def create(self) -> None:
+        dirs = " ".join(shlex.quote(str(self.cfg.worker_dir(k)))
+                        for k in range(self.cfg.num_workers))
+        self.exec.run(["sh", "-c", f"mkdir -p {dirs}"], verb="create")
+        self._write_state({"phase": "created",
+                           "workers": [{"worker": k, "pid": None,
+                                        "logdir": str(self.cfg.worker_dir(k))}
+                                       for k in range(self.cfg.num_workers)]})
+        if self.cfg.setup_command:
+            self.exec_all(self.cfg.setup_command)
+
+    def delete(self, ignore_missing: bool = False) -> None:
+        """Kill every worker, mark the cluster deleted. Logdirs are
+        retained (≙ the reference's shutdown, which terminated instances
+        but kept the NFS outdir) — a caller wanting a clean slate
+        removes ``cfg.root``."""
+        self.kill_all()
+        state = self._read_state()
+        state["phase"] = "deleted"
+        self._write_state(state)
+        self.exec.journal({"event": "lifecycle", "action": "delete",
+                           "cluster": self.cfg.name})
+
+    def _worker_env(self, k: int) -> dict[str, str]:
+        # a parent that forced a virtual device mesh (tests) must not
+        # leak it into the workers — they boot the real 1-device CPU
+        # platform
+        from ..core.mesh import strip_forced_platform_env
+        env = strip_forced_platform_env(dict(os.environ))
+        env["JAX_PLATFORMS"] = "cpu"
+        # workers run with cwd = their logdir, so the default
+        # `python -m distributedmnist_tpu...` train command can only
+        # resolve this package if its repo root is importable — put it
+        # first on PYTHONPATH (a pip-installed copy is unaffected;
+        # cfg.env below still overrides)
+        repo_root = str(Path(__file__).resolve().parents[2])
+        env["PYTHONPATH"] = os.pathsep.join(
+            [repo_root] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH")
+                           else []))
+        env.update(self.cfg.env)
+        env.update({"DMT_WORKER_INDEX": str(k),
+                    "DMT_NUM_WORKERS": str(self.cfg.num_workers),
+                    "DMT_WORKER_DIR": str(self.cfg.worker_dir(k))})
+        return env
+
+    def run_train(self) -> None:
+        """Spawn one REAL detached process per worker (≙ run_tf's
+        nohup-per-host, tf_ec2.py:445) — stdout/stderr to the worker's
+        ``train_stdout.log``, pid recorded in the cluster state."""
+        state = self._read_state()
+        if not state["workers"]:
+            raise ClusterError("run_train before create: no workers")
+        delay_s = self.exec.fault_plan.command_delay_s("run")
+        for w in state["workers"]:
+            k = w["worker"]
+            logdir = Path(w["logdir"])
+            if self.exec.dry_run:  # record the spawn argv, don't Popen
+                self.exec.run(["sh", "-c", self.cfg.train_command],
+                              verb="run")
+                continue
+            if delay_s > 0:
+                time.sleep(delay_s)
+            log_fh = open(logdir / "train_stdout.log", "ab")
+            try:
+                proc = subprocess.Popen(
+                    ["sh", "-c", self.cfg.train_command],
+                    cwd=logdir, env=self._worker_env(k),
+                    stdout=log_fh, stderr=subprocess.STDOUT,
+                    start_new_session=True)
+            finally:
+                log_fh.close()  # the child holds its own descriptor
+            w["pid"] = proc.pid
+            self.exec.journal({"event": "spawn", "worker": k,
+                               "pid": proc.pid,
+                               "command": self.cfg.train_command})
+        state["phase"] = "running"
+        self._write_state(state)
+
+    def _select(self, workers: list[dict], worker: str) -> list[dict]:
+        if worker == "all":
+            return workers
+        return [w for w in workers if w["worker"] == int(worker)]
+
+    def _kill_pid(self, pid: int, verb: str) -> None:
+        self.exec.run(["sh", "-c", f"kill -9 {pid} 2>/dev/null || true"],
+                      verb=verb, check=False)
+
+    def kill_all(self, worker: str = "all") -> None:
+        state = self._read_state()
+        for w in self._select(state["workers"], worker):
+            if w.get("pid"):
+                self._kill_pid(w["pid"], "kill")
+
+    def status(self) -> dict[str, Any] | None:
+        """pgrep-equivalent liveness per worker — a REAL ``kill -0``
+        subprocess per pid (≙ the idle probe the gcloud backend sends
+        over SSH), so a worker killed mid-run surfaces as
+        ``alive: False`` here."""
+        if self.exec.dry_run:
+            return None  # the backend contract's dry-run sentinel; the
+            # liveness probes need real pids, so there is no argv to record
+        state = self._read_state()
+        workers = []
+        for w in state["workers"]:
+            alive = False
+            if w.get("pid"):
+                probe = self.exec.run(
+                    ["sh", "-c", f"kill -0 {w['pid']} 2>/dev/null"],
+                    verb="status", check=False, max_attempts=1)
+                # max_attempts=1: a dead pid is not transient — a
+                # retrying executor must not burn its budget observing it
+                alive = probe is not None and probe.returncode == 0
+            workers.append({"worker": w["worker"], "pid": w.get("pid"),
+                            "alive": alive, "logdir": w["logdir"]})
+        return {"state": state["phase"].upper(),
+                "workers": workers,
+                "idle": not any(w["alive"] for w in workers)}
+
+    def exec_all(self, command: str, worker: str = "all") -> None:
+        state = self._read_state()
+        for w in self._select(state["workers"], worker):
+            self.exec.run(["sh", "-c", command], verb="exec",
+                          cwd=w["logdir"], env=self._worker_env(w["worker"]))
+
+    def download(self, local_dir: str | Path, remote_path: str | None = None,
+                 worker: str = "0") -> None:
+        """File-copy "download" of a worker's logdir — a real ``cp -r``
+        subprocess (≙ the scp download path, tf_ec2.py:651-742)."""
+        state = self._read_state()
+        local_dir = Path(local_dir)
+        local_dir.mkdir(parents=True, exist_ok=True)
+        for w in self._select(state["workers"], worker):
+            src = remote_path or w["logdir"]
+            self.exec.run(["cp", "-r", str(src), str(local_dir)],
+                          verb="download")
+
+    def poll(self) -> dict[str, Any] | None:
+        """Tail worker 0's ``train_log.jsonl`` via a real subprocess;
+        additionally the seam where the fault plan's mid-run worker
+        kill fires (the poll cadence is when the driver looks at the
+        cluster — exactly when a lost worker becomes observable)."""
+        state = self._read_state()
+        if not state["workers"]:
+            return {"step": -1, "record": None}
+        log = Path(state["workers"][0]["logdir"]) / "train_log.jsonl"
+        out = self.exec.run(
+            ["sh", "-c", f"tail -n 1 {shlex.quote(str(log))} "
+                         f"2>/dev/null || true"],
+            verb="poll", check=False)
+        if out is None:  # dry-run: tail argv recorded above
+            return None
+        got = parse_poll_output(out.stdout)
+        if state["phase"] == "running":
+            got["workers_alive"] = sum(
+                w["alive"] for w in self.status()["workers"])
+        for k, s in self.exec.fault_plan.kill_worker_at_step.items():
+            if got["step"] >= s and k not in self._fault_killed:
+                self._fault_killed.add(k)
+                for w in self._select(state["workers"], str(k)):
+                    if w.get("pid"):
+                        self._kill_pid(w["pid"], "fault")
+                        self.exec.journal(
+                            {"event": "fault", "action": "kill_worker",
+                             "worker": k, "pid": w["pid"],
+                             "at_step": got["step"], "planned_step": s})
+        return got
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def make_backend(backend: str, config: str | None,
+                 executor: CommandExecutor) -> ClusterBackend:
+    """Backend factory — the pluggability seam the CLI and tests use."""
+    if backend == "local":
+        cfg = (LocalClusterConfig.from_file(config) if config
+               else LocalClusterConfig())
+        return LocalProcessCluster(cfg, executor)
+    if backend == "gcloud":
+        from .pod import PodConfig
+        cfg = PodConfig.from_file(config) if config else PodConfig()
+        return GcloudTpuBackend(cfg, executor)
+    raise ClusterError(f"unknown backend {backend!r} "
+                       "(choices: local, gcloud)")
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="distributedmnist_tpu.launch cluster")
+    p.add_argument("action",
+                   choices=["create", "delete", "status", "run", "kill-all",
+                            "exec", "download", "poll"])
+    p.add_argument("--backend", default="local", choices=["local", "gcloud"])
+    p.add_argument("--config", default=None,
+                   help="LocalClusterConfig / PodConfig JSON")
+    p.add_argument("--fault-plan", default=None, help="FaultPlan JSON")
+    p.add_argument("--journal", default=None,
+                   help="command journal JSONL path (local backend "
+                        "defaults to <workdir>/command_journal.jsonl)")
+    p.add_argument("--dry-run", action="store_true",
+                   help="record commands instead of executing")
+    p.add_argument("--command", default=None, help="for exec")
+    p.add_argument("--worker", default=None, help="worker index or 'all'")
+    p.add_argument("--local-dir", default="./cluster_results",
+                   help="for download")
+    p.add_argument("--remote-path", default=None, help="for download")
+    p.add_argument("--timeout-s", type=float, default=None,
+                   help="per-command timeout")
+    p.add_argument("--max-attempts", type=int, default=1,
+                   help="retry budget for transient command failures")
+    p.add_argument("--until-step", type=int, default=None, metavar="N",
+                   help="for run/poll: follow train_log.jsonl and return "
+                        "at step N (run also stops the cluster)")
+    p.add_argument("--poll-secs", type=float, default=5.0)
+    p.add_argument("--poll-timeout-s", type=float, default=24 * 3600.0)
+    args = p.parse_args(argv)
+
+    fault = FaultPlan.from_file(args.fault_plan) if args.fault_plan else None
+    journal = args.journal
+    if journal is None and args.backend == "local" and not args.dry_run:
+        cfg0 = (LocalClusterConfig.from_file(args.config) if args.config
+                else LocalClusterConfig())
+        cfg0.root.mkdir(parents=True, exist_ok=True)
+        journal = cfg0.root / "command_journal.jsonl"
+    executor = CommandExecutor(
+        journal=journal,
+        retry=RetryPolicy(max_attempts=args.max_attempts),
+        timeout_s=args.timeout_s, fault_plan=fault, dry_run=args.dry_run)
+    backend = make_backend(args.backend, args.config, executor)
+
+    if args.action == "create":
+        backend.create()
+    elif args.action == "delete":
+        backend.delete()
+    elif args.action == "status":
+        print(json.dumps(backend.status(), indent=2))
+    elif args.action == "run":
+        if args.until_step is not None:
+            print(json.dumps(run_until_step(
+                backend, args.until_step, poll_secs=args.poll_secs,
+                timeout_secs=args.poll_timeout_s)))
+        else:
+            backend.run_train()
+    elif args.action == "poll":
+        if args.until_step is not None:
+            print(json.dumps(wait_until_step(
+                backend, args.until_step, poll_secs=args.poll_secs,
+                timeout_secs=args.poll_timeout_s)))
+        else:
+            print(json.dumps(backend.poll()))
+    elif args.action == "kill-all":
+        backend.kill_all(worker=args.worker or "all")
+    elif args.action == "exec":
+        if not args.command:
+            p.error("exec requires --command")
+        backend.exec_all(args.command, worker=args.worker or "all")
+    elif args.action == "download":
+        backend.download(args.local_dir, args.remote_path,
+                         worker=args.worker or "0")
+    if args.dry_run:
+        print(json.dumps([shlex.join(a) for a in executor.recorded],
+                         indent=2))
+    executor.close()

@@ -1,0 +1,286 @@
+"""Command execution engine beneath the cluster backends.
+
+≙ the reference's ad-hoc ``run_ssh_commands_parallel`` + retry loops
+(tools/tf_ec2.py:536-569 fan-out, :237-271 launch-and-wait): every
+shell interaction there was a bare ``subprocess``/paramiko call with
+hand-rolled sleeps. Here ONE executor owns the subprocess boundary for
+the whole launch layer and gives every command:
+
+* a per-command **timeout** (a hung ``gcloud ssh`` must not hang the
+  driver),
+* bounded **retry with exponential backoff + jitter** on transient
+  failures (nonzero rc / timeout — the reference re-ran whole launches
+  by hand when a spot request or SSH flaked),
+* a structured **JSONL command journal** (argv, rc, duration_ms,
+  attempt, stdout/stderr tails) so a run leaves auditable evidence of
+  exactly what executed — the artifact `obsv.journal` summarizes,
+* a **fault-injection seam** (:class:`FaultPlan`) so the failure
+  handling above is *testable* with real subprocesses: fail the first
+  n attempts of a verb, delay a command class, kill a worker mid-run
+  (the backup-workers regime of arXiv:1604.00981, applied to the
+  control plane).
+
+Dry-run records argv without executing — the same audit seam
+``launch/pod.py`` has always had, now shared by every backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import shlex
+import subprocess
+import time
+from pathlib import Path
+from typing import Sequence
+
+from ..core.log import JsonlSink, get_logger, text_tail
+
+logger = get_logger("exec")
+
+
+class ExecError(RuntimeError):
+    """A command could not be executed or exhausted its attempt budget."""
+
+
+class BinaryNotFoundError(ExecError):
+    """argv[0] is not on PATH — permanent, never retried. A distinct
+    type so callers can tell a missing CLI from a command whose stderr
+    merely contains the words "not found" (e.g. a gcloud NOT_FOUND
+    resource error)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff with jitter.
+
+    Delay before retry ``k`` (1-based count of failures so far) is
+    ``min(max_backoff_s, backoff_s * multiplier**(k-1))`` scaled by a
+    uniform jitter in ``[1-jitter_frac, 1+jitter_frac]`` — jitter so N
+    workers retrying the same flaked control-plane verb do not
+    re-stampede it in lockstep.
+    """
+
+    max_attempts: int = 3
+    backoff_s: float = 0.25
+    multiplier: float = 2.0
+    max_backoff_s: float = 8.0
+    jitter_frac: float = 0.25
+    seed: int | None = None  # deterministic jitter for tests
+
+    def __post_init__(self) -> None:
+        if self.max_attempts < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {self.max_attempts}")
+
+    def delay_s(self, failures: int, rng: random.Random) -> float:
+        base = min(self.max_backoff_s,
+                   self.backoff_s * self.multiplier ** (failures - 1))
+        if self.jitter_frac <= 0:
+            return base
+        return base * (1.0 + self.jitter_frac * (2.0 * rng.random() - 1.0))
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Declarative fault injection, wired through the executor and the
+    local backend (≙ the failure regime of arXiv:1604.00981 — dead and
+    slow workers — applied to the execution layer).
+
+    ``fail_first``         {verb: n}   — synthesize a failure for the
+                                         first n attempts of ``verb``
+                                         (tests retry/backoff recovery)
+    ``delay_ms``           {verb: ms}  — sleep before every execution
+                                         of ``verb`` (straggler class)
+    ``kill_worker_at_step`` {k: s}     — LocalProcessCluster kills
+                                         worker ``k`` once a poll
+                                         observes step >= ``s``
+                                         (mid-run worker loss)
+    """
+
+    fail_first: dict[str, int] = dataclasses.field(default_factory=dict)
+    delay_ms: dict[str, float] = dataclasses.field(default_factory=dict)
+    kill_worker_at_step: dict[int, int] = dataclasses.field(
+        default_factory=dict)
+
+    @classmethod
+    def from_file(cls, path: str | Path) -> "FaultPlan":
+        d = json.loads(Path(path).read_text())
+        unknown = set(d) - {f.name for f in dataclasses.fields(cls)}
+        if unknown:
+            raise ExecError(f"unknown fault plan keys: {sorted(unknown)}")
+        # JSON object keys are strings; worker indices are ints
+        if "kill_worker_at_step" in d:
+            d["kill_worker_at_step"] = {int(k): int(v)
+                                        for k, v in
+                                        d["kill_worker_at_step"].items()}
+        return cls(**d)
+
+    def should_fail(self, verb: str, attempt: int) -> bool:
+        return attempt <= self.fail_first.get(verb, 0)
+
+    def command_delay_s(self, verb: str) -> float:
+        return self.delay_ms.get(verb, 0.0) / 1e3
+
+
+@dataclasses.dataclass
+class ExecResult:
+    """Outcome of one :meth:`CommandExecutor.run` call (final attempt)."""
+
+    argv: list[str]
+    returncode: int | None       # None ⇔ the attempt timed out
+    duration_ms: float
+    attempts: int
+    stdout: str | None
+    stderr: str | None
+    timed_out: bool = False
+    injected: bool = False       # failure synthesized by the FaultPlan
+
+    @property
+    def ok(self) -> bool:
+        return self.returncode == 0 and not self.timed_out
+
+
+class CommandExecutor:
+    """Runs argv lists with timeout / retry / journal / fault seams.
+
+    One instance per cluster action sequence; every attempt of every
+    command appends one JSONL record to ``journal`` (a path or an open
+    :class:`JsonlSink`), so the artifact alone reconstructs what ran.
+    """
+
+    def __init__(self, journal: str | Path | JsonlSink | None = None,
+                 retry: RetryPolicy | None = None,
+                 timeout_s: float | None = None,
+                 fault_plan: FaultPlan | None = None,
+                 dry_run: bool = False,
+                 sleep=time.sleep):
+        self.retry = retry or RetryPolicy()
+        self.timeout_s = timeout_s
+        self.fault_plan = fault_plan or FaultPlan()
+        self.dry_run = dry_run
+        self.recorded: list[list[str]] = []
+        self._sleep = sleep
+        self._rng = random.Random(self.retry.seed)
+        self._own_journal = not isinstance(journal, JsonlSink)
+        self._journal: JsonlSink | None = (
+            journal if isinstance(journal, JsonlSink)
+            else JsonlSink(journal) if journal is not None else None)
+
+    @property
+    def journal_path(self) -> Path | None:
+        return self._journal.path if self._journal else None
+
+    def close(self) -> None:
+        if self._journal is not None and self._own_journal:
+            self._journal.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def journal(self, record: dict) -> None:
+        """Append a non-command record (spawn, fault, lifecycle marker)
+        to the same journal the commands land in."""
+        if self._journal is not None:
+            self._journal.write(record)
+
+    _log = journal
+
+    # ------------------------------------------------------------------
+
+    def run(self, argv: Sequence[str], *, verb: str | None = None,
+            check: bool = True, capture: bool = True,
+            timeout_s: float | None = None,
+            max_attempts: int | None = None,
+            cwd: str | Path | None = None,
+            env: dict[str, str] | None = None) -> ExecResult | None:
+        """Execute ``argv``; retry transient failures within the budget.
+
+        ``verb`` names the command class for the journal and the fault
+        plan (defaults to ``argv[0]``). Transient = nonzero rc or
+        timeout; a missing binary is permanent and raises immediately.
+        Returns the final :class:`ExecResult`, or None under dry-run
+        (argv recorded + journaled). ``check=True`` raises
+        :class:`ExecError` when the final attempt still failed.
+        """
+        argv = [str(a) for a in argv]
+        verb = verb or argv[0]
+        timeout_s = self.timeout_s if timeout_s is None else timeout_s
+        budget = max_attempts or self.retry.max_attempts
+        self.recorded.append(argv)
+        if self.dry_run:
+            logger.info("DRY-RUN: %s", shlex.join(argv))
+            self._log({"event": "command", "verb": verb, "argv": argv,
+                       "dry_run": True})
+            return None
+
+        last: ExecResult | None = None
+        for attempt in range(1, budget + 1):
+            delay_s = self.fault_plan.command_delay_s(verb)
+            if delay_s > 0:
+                self._sleep(delay_s)
+            t0 = time.perf_counter()
+            if self.fault_plan.should_fail(verb, attempt):
+                res = ExecResult(argv=argv, returncode=1,
+                                 duration_ms=0.0, attempts=attempt,
+                                 stdout="", injected=True,
+                                 stderr=f"fault-injected failure "
+                                        f"(verb={verb!r} attempt={attempt})")
+            else:
+                try:
+                    cp = subprocess.run(argv, text=True,
+                                        capture_output=capture,
+                                        timeout=timeout_s,
+                                        cwd=cwd, env=env)
+                    res = ExecResult(
+                        argv=argv, returncode=cp.returncode,
+                        duration_ms=(time.perf_counter() - t0) * 1e3,
+                        attempts=attempt, stdout=cp.stdout,
+                        stderr=cp.stderr)
+                except subprocess.TimeoutExpired as e:
+                    res = ExecResult(
+                        argv=argv, returncode=None,
+                        duration_ms=(time.perf_counter() - t0) * 1e3,
+                        attempts=attempt, timed_out=True,
+                        stdout=e.stdout if isinstance(e.stdout, str) else None,
+                        stderr=e.stderr if isinstance(e.stderr, str) else None)
+                except FileNotFoundError as e:
+                    self._log({"event": "command", "verb": verb,
+                               "argv": argv, "rc": None, "attempt": attempt,
+                               "error": "binary not found"})
+                    raise BinaryNotFoundError(
+                        f"{argv[0]!r} not found on PATH") from e
+            will_retry = (not res.ok) and attempt < budget
+            self._log({"event": "command", "verb": verb, "argv": argv,
+                       "rc": res.returncode,
+                       "duration_ms": round(res.duration_ms, 3),
+                       "attempt": attempt, "check": check,
+                       "timed_out": res.timed_out,
+                       "injected": res.injected,
+                       "injected_delay_ms": delay_s * 1e3 or None,
+                       "stdout_tail": text_tail(res.stdout),
+                       "stderr_tail": text_tail(res.stderr),
+                       "will_retry": will_retry})
+            if res.ok:
+                return res
+            last = res
+            if will_retry:
+                backoff = self.retry.delay_s(attempt, self._rng)
+                logger.warning(
+                    "command failed (verb=%s rc=%s timed_out=%s) — "
+                    "attempt %d/%d, retrying in %.3fs", verb,
+                    res.returncode, res.timed_out, attempt, budget, backoff)
+                self._sleep(backoff)
+        assert last is not None
+        if check:
+            why = "timed out" if last.timed_out else f"rc={last.returncode}"
+            raise ExecError(
+                f"command failed after {last.attempts} attempt(s) "
+                f"({why}): {shlex.join(argv)}"
+                + (f"\nstderr tail: {text_tail(last.stderr, 500)}"
+                   if last.stderr else ""))
+        return last

@@ -9,25 +9,32 @@ PS_HOSTS/WORKER_HOSTS/TASK_ID/JOB_NAME templating, :493-534,
 disappears), SSH fan-out is ``gcloud compute tpus tpu-vm ssh
 --worker=all``, and downloads are ``gcloud ... scp``.
 
-Subcommand parity map (reference dispatch table → here):
+Subcommand parity map (reference dispatch table → here; "exec" in the
+coverage column = the verb is exercised by tests as a real executed
+subprocess — against a stubbed ``gcloud`` on PATH here, and as real
+local worker processes on ``launch/cluster.py``'s LocalProcessCluster):
 
-  launch                 → create            (tf_ec2.py:796, :237-271)
-  shutdown               → delete            (:440)
-  clean_launch_and_run   → clean-launch-run  (:806)
-  run_tf                 → run               (:445)
-  kill_all_python        → kill-all          (:637)
-  kill_python            → kill-all --worker (:617)
-  list_idle_instances    → status (idle = no python running, :371-402)
-  list_running_instances → status            (:404)
-  run_command            → exec              (:841)
-  download_outdir        → download          (:651-697)
-  download_file          → download --file   (:699-742)
+  launch                 → create            (tf_ec2.py:796, :237-271)  [exec]
+  shutdown               → delete            (:440)                     [exec]
+  clean_launch_and_run   → clean-launch-run  (:806)                     [argv]
+  run_tf                 → run               (:445)                     [exec]
+  kill_all_python        → kill-all          (:637)                     [exec]
+  kill_python            → kill-all --worker (:617)                     [exec]
+  list_idle_instances    → status (idle = no python running, :371-402)  [exec]
+  list_running_instances → status            (:404)                     [exec]
+  run_command            → exec              (:841)                     [exec]
+  download_outdir        → download          (:651-697)                 [exec]
+  download_file          → download --file   (:699-742)                 [argv]
 
-Every action goes through a ``Runner`` that either executes the
-``gcloud`` CLI or records the exact argv (dry-run) — the test seam,
-and also how a human can audit what would run. No cloud SDK is
-imported; environments without ``gcloud`` get a clear error only when
-a command is actually executed.
+The argv builders and every verb now live in
+:class:`~.cluster.GcloudTpuBackend` — one of the pluggable
+:class:`~.cluster.ClusterBackend` realizations — and ``PodManager``
+is the thin TPU-facing surface over it. Every action goes through a
+``Runner`` (a compat shim over :class:`~.exec.CommandExecutor`) that
+either executes the ``gcloud`` CLI or records the exact argv (dry-run)
+— the test seam, and also how a human can audit what would run. No
+cloud SDK is imported; environments without ``gcloud`` get a clear
+error only when a command is actually executed.
 """
 
 from __future__ import annotations
@@ -40,6 +47,10 @@ from pathlib import Path
 from typing import Any, Sequence
 
 from ..core.log import get_logger
+from .cluster import ClusterError, GcloudTpuBackend
+from . import cluster as cluster_lib
+from .exec import (BinaryNotFoundError, CommandExecutor, ExecError,
+                   RetryPolicy)
 
 logger = get_logger("pod")
 
@@ -75,134 +86,89 @@ class PodConfig:
         return cls(**d)
 
 
-class Runner:
-    """Executes argv lists, or records them under dry_run."""
+class Runner(CommandExecutor):
+    """Executes argv lists, or records them under dry_run.
 
-    def __init__(self, dry_run: bool = False):
-        self.dry_run = dry_run
-        self.recorded: list[list[str]] = []
+    The historical pod seam, now a shim over
+    :class:`~.exec.CommandExecutor`: same ``run(argv, check, capture)``
+    call shape and ``recorded`` audit list, with the executor's
+    timeout / journal / fault seams available underneath. No retries
+    by default — gcloud verbs are not assumed idempotent; opt in with
+    ``Runner(retry=RetryPolicy(max_attempts=3))``.
+    """
 
-    def run(self, argv: Sequence[str], check: bool = True,
-            capture: bool = False) -> subprocess.CompletedProcess | None:
-        argv = list(argv)
-        self.recorded.append(argv)
-        if self.dry_run:
-            logger.info("DRY-RUN: %s", shlex.join(argv))
-            return None
+    def __init__(self, dry_run: bool = False, **kw):
+        kw.setdefault("retry", RetryPolicy(max_attempts=1))
+        super().__init__(dry_run=dry_run, **kw)
+
+    def run(self, argv: Sequence[str], check: bool = True,  # type: ignore[override]
+            capture: bool = False, **kw) -> subprocess.CompletedProcess | None:
         try:
-            return subprocess.run(argv, check=check, text=True,
-                                  capture_output=capture)
-        except FileNotFoundError as e:
+            res = super().run(argv, check=check, capture=capture, **kw)
+        except BinaryNotFoundError as e:
             raise PodError(
-                f"{argv[0]!r} not found — pod management needs the gcloud "
-                "CLI on PATH (or use --dry-run to inspect commands)") from e
-        except subprocess.CalledProcessError as e:
-            raise PodError(f"command failed ({e.returncode}): "
-                           f"{shlex.join(argv)}") from e
+                f"{argv[0]!r} not found — pod management needs the "
+                "gcloud CLI on PATH (or use --dry-run to inspect "
+                "commands)") from e
+        except ExecError as e:
+            raise PodError(str(e)) from e
+        if res is None:  # dry-run
+            return None
+        return subprocess.CompletedProcess(
+            args=res.argv,
+            returncode=124 if res.timed_out else res.returncode,
+            stdout=res.stdout, stderr=res.stderr)
 
 
 class PodManager:
-    """All pod actions as methods; argv construction is pure, so every
-    action is testable via Runner(dry_run=True)."""
+    """All pod actions as methods; argv construction (pure, in
+    :class:`GcloudTpuBackend`) is separate from execution, so every
+    action is testable via Runner(dry_run=True) — and executable for
+    real against a stubbed ``gcloud`` on PATH."""
 
     def __init__(self, cfg: PodConfig, runner: Runner | None = None):
         self.cfg = cfg
         self.runner = runner or Runner()
-
-    # -- argv builders (pure) -------------------------------------------
-
-    def _base(self, *verb: str) -> list[str]:
-        argv = ["gcloud", "compute", "tpus", "tpu-vm", *verb, self.cfg.name,
-                "--zone", self.cfg.zone]
-        if self.cfg.project:
-            argv += ["--project", self.cfg.project]
-        return argv
-
-    def _ssh(self, command: str, worker: str = "all") -> list[str]:
-        exports = "".join(f"export {k}={shlex.quote(v)}; "
-                          for k, v in self.cfg.env.items())
-        return self._base("ssh") + ["--worker", worker,
-                                    "--command", exports + command]
+        self.backend = GcloudTpuBackend(cfg, self.runner)
 
     # -- lifecycle ------------------------------------------------------
 
     def create(self) -> None:
         """≙ launch (tf_ec2.py:796): create the slice, run setup."""
-        argv = self._base("create") + [
-            "--accelerator-type", self.cfg.accelerator_type,
-            "--version", self.cfg.runtime_version]
-        if self.cfg.spot:
-            argv.append("--spot")
-        self.runner.run(argv)
-        if self.cfg.setup_command:
-            self.runner.run(self._ssh(self.cfg.setup_command))
+        self.backend.create()
 
     def delete(self) -> None:
         """≙ shutdown (tf_ec2.py:440)."""
-        self.runner.run(self._base("delete") + ["--quiet"])
+        self.backend.delete()
 
     def status(self) -> dict[str, Any] | None:
         """≙ list_running/list_idle (tf_ec2.py:371-404): slice state
         plus whether python is running on any worker."""
-        out = self.runner.run(self._base("describe") + ["--format", "json"],
-                              capture=True)
-        # [d]… so the pattern never matches the ssh-spawned shell whose
-        # own command line contains it (pgrep -f excludes only itself).
-        probe = self.runner.run(
-            self._ssh("pgrep -c -f '[d]istributedmnist_tpu.launch' || true"),
-                                capture=True, check=False)
-        if out is None:  # dry-run: both argvs recorded above, no result
-            return None
-        desc = json.loads(out.stdout)
-        if probe is None or probe.returncode != 0:
-            idle = None  # probe failed — unknown, NOT "idle" (a caller
-            # keying deletion off idle must not kill a live run)
-        else:
-            idle = not any(line.strip() not in ("", "0")
-                           for line in (probe.stdout or "").splitlines())
-        return {"state": desc.get("state"), "idle": idle, "describe": desc}
+        return self.backend.status()
 
     # -- work -----------------------------------------------------------
 
     def run_train(self) -> None:
-        """≙ run_tf (tf_ec2.py:445): same command on every worker —
-        jax.distributed discovers the slice topology; no role/host
-        templating exists."""
-        outdir = shlex.quote(self.cfg.remote_outdir)
-        log = shlex.quote(f"{self.cfg.remote_outdir}/train_stdout.log")
-        self.runner.run(self._ssh(
-            f"mkdir -p {outdir} && cd ~ && "
-            f"nohup {self.cfg.train_command} > {log} 2>&1 &"))
+        """≙ run_tf (tf_ec2.py:445): same command on every worker."""
+        self.backend.run_train()
 
     def kill_all(self, worker: str = "all") -> None:
         """≙ kill_all_python / kill_python (tf_ec2.py:617-649)."""
-        self.runner.run(self._ssh("pkill -9 -f python || true", worker=worker),
-                        check=False)
+        self.backend.kill_all(worker=worker)
 
     def exec(self, command: str, worker: str = "all") -> None:
         """≙ run_command (tf_ec2.py:841)."""
-        self.runner.run(self._ssh(command, worker=worker))
+        self.backend.exec_all(command, worker=worker)
 
     def download(self, local_dir: str | Path, remote_path: str | None = None,
                  worker: str = "0") -> None:
         """≙ download_outdir / download_file (tf_ec2.py:651-742)."""
-        remote = remote_path or self.cfg.remote_outdir
-        local_dir = Path(local_dir)
-        local_dir.mkdir(parents=True, exist_ok=True)
-        # scp's positional is <name>:<path>, not a bare name, so the
-        # _base helper doesn't apply
-        argv = ["gcloud", "compute", "tpus", "tpu-vm", "scp",
-                "--zone", self.cfg.zone]
-        if self.cfg.project:
-            argv += ["--project", self.cfg.project]
-        argv += ["--worker", worker, "--recurse",
-                 f"{self.cfg.name}:{remote}", str(local_dir)]
-        self.runner.run(argv)
+        self.backend.download(local_dir, remote_path, worker=worker)
 
     def clean_launch_and_run(self) -> None:
         """≙ clean_launch_and_run (tf_ec2.py:806): delete-if-exists →
         create → run."""
-        self.runner.run(self._base("delete") + ["--quiet"], check=False)
+        self.backend.delete(ignore_missing=True)
         self.create()
         self.run_train()
 
@@ -211,64 +177,35 @@ class PodManager:
     def poll(self) -> dict[str, Any] | None:
         """One progress probe: tail the remote ``train_log.jsonl``
         (worker 0 — every host logs the same replicated metrics) and
-        parse the newest record. ≙ the reference's master-log poll that
-        greps ``Step N`` out of the remote stdout
-        (tools/benchmark.py:24-34), against the structured log instead
-        of a regex over freeform text.
-
-        Returns {"step", "record"} — step is -1 when the log does not
-        exist yet (run still booting). Dry-run returns None (argv
-        recorded).
-        """
-        log = shlex.quote(f"{self.cfg.remote_outdir}/train_log.jsonl")
-        out = self.runner.run(
-            self._ssh(f"tail -n 1 {log} 2>/dev/null || true", worker="0"),
-            capture=True, check=False)
-        if out is None:
-            return None
-        line = (out.stdout or "").strip().splitlines()
-        if not line:
-            return {"step": -1, "record": None}
-        try:
-            record = json.loads(line[-1])
-        except json.JSONDecodeError:
-            return {"step": -1, "record": None}  # torn write — next poll
-        return {"step": int(record.get("step", -1)), "record": record}
+        parse the newest record. ≙ the reference's master-log poll
+        (tools/benchmark.py:24-34). Returns {"step", "record"} — step
+        is -1 when the log does not exist yet. Dry-run returns None
+        (argv recorded)."""
+        return self.backend.poll()
 
     def wait_until_step(self, target: int, poll_secs: float = 30.0,
                         timeout_secs: float = 24 * 3600.0) -> dict[str, Any]:
         """Block until the remote run reaches ``target`` steps
         (≙ benchmark.py's run-until-step-N loop :24-34). Dry-run
         records exactly one poll argv and returns immediately."""
-        import time as _time
-        deadline = _time.monotonic() + timeout_secs
-        while True:
-            got = self.poll()
-            if got is None:  # dry-run
-                return {"step": target, "record": None, "dry_run": True}
-            if got["step"] >= target:
-                return got
-            if _time.monotonic() >= deadline:
-                raise PodError(
-                    f"remote run did not reach step {target} within "
-                    f"{timeout_secs:.0f}s (last seen: {got['step']})")
-            logger.info("step %d/%d — next poll in %.0fs",
-                        got["step"], target, poll_secs)
-            _time.sleep(poll_secs)
+        try:
+            return cluster_lib.wait_until_step(self.backend, target,
+                                               poll_secs, timeout_secs)
+        except ClusterError as e:
+            raise PodError(f"remote {e}") from None
 
     def run_until_step(self, target: int, poll_secs: float = 30.0,
                        timeout_secs: float = 24 * 3600.0) -> dict[str, Any]:
         """Launch training, follow the remote log to step ``target``,
         then stop the run — the reference's benchmark driver shape
         (launch → poll ssh'd log → kill at N, tools/benchmark.py:24-44).
-        """
-        self.run_train()
+        The cluster is stopped on EVERY exit — a poll timeout or a
+        Ctrl-C must not leave the pod training (and billing)."""
         try:
-            return self.wait_until_step(target, poll_secs, timeout_secs)
-        finally:
-            # stop the remote run on EVERY exit — a poll timeout or a
-            # Ctrl-C must not leave the pod training (and billing)
-            self.kill_all()
+            return cluster_lib.run_until_step(self.backend, target,
+                                              poll_secs, timeout_secs)
+        except ClusterError as e:
+            raise PodError(f"remote {e}") from None
 
 
 def main(argv: list[str] | None = None) -> None:
@@ -281,6 +218,12 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--config", default=None, help="PodConfig JSON")
     p.add_argument("--dry-run", action="store_true",
                    help="print gcloud commands instead of executing")
+    p.add_argument("--journal", default=None,
+                   help="command journal JSONL path")
+    p.add_argument("--timeout-s", type=float, default=None,
+                   help="per-gcloud-command timeout")
+    p.add_argument("--max-attempts", type=int, default=1,
+                   help="retry budget for transient gcloud failures")
     p.add_argument("--command", default=None, help="for exec")
     p.add_argument("--worker", default=None, help="worker index or 'all'")
     p.add_argument("--local-dir", default="./pod_results", help="for download")
@@ -294,7 +237,10 @@ def main(argv: list[str] | None = None) -> None:
     args = p.parse_args(argv)
 
     cfg = PodConfig.from_file(args.config) if args.config else PodConfig()
-    mgr = PodManager(cfg, Runner(dry_run=args.dry_run))
+    mgr = PodManager(cfg, Runner(
+        dry_run=args.dry_run, journal=args.journal,
+        timeout_s=args.timeout_s,
+        retry=RetryPolicy(max_attempts=args.max_attempts)))
     if args.action == "create":
         mgr.create()
     elif args.action == "delete":
@@ -327,3 +273,4 @@ def main(argv: list[str] | None = None) -> None:
     if args.dry_run:
         print(json.dumps([shlex.join(a) for a in mgr.runner.recorded],
                          indent=2))
+    mgr.runner.close()

@@ -98,3 +98,9 @@ def loss_fn(logits: jax.Array, labels: jax.Array) -> jax.Array:
 def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     """Top-1 accuracy (≙ src/mnist.py:161-164)."""
     return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+
+
+def predictions(logits: jax.Array) -> jax.Array:
+    """Softmax class probabilities [batch, num_classes] — the export
+    surface (≙ tf.nn.softmax(logits), src/mnist.py:166-167)."""
+    return jax.nn.softmax(logits, axis=-1)

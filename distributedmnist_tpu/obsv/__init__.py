@@ -1,3 +1,5 @@
+from .journal import load_journal, summarize_journal
 from .timing import CdfStats, StepTimeCollector, compute_stats
 
-__all__ = ["CdfStats", "StepTimeCollector", "compute_stats"]
+__all__ = ["CdfStats", "StepTimeCollector", "compute_stats",
+           "load_journal", "summarize_journal"]

@@ -1,0 +1,70 @@
+"""Command-journal analysis: the obsv view of ``launch/exec.py``'s JSONL.
+
+Every cluster action leaves a ``command_journal.jsonl``; this module
+loads it torn-write-tolerantly and aggregates the run into per-verb
+stats — attempt counts, retry/failure totals, duration percentiles —
+the same load-then-aggregate shape ``obsv/report.py`` applies to
+training logs (≙ the reference's regex scrape of orchestrator output,
+tools/benchmark.py:24-34, replaced by structured records).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+from .report import load_jsonl
+
+
+def load_journal(path: str | Path) -> list[dict]:
+    """Command records from a journal (tolerates a torn tail write)."""
+    return load_jsonl(path, event="command")
+
+
+def summarize_journal(path: str | Path) -> dict[str, Any]:
+    """Aggregate a command journal into run-level evidence.
+
+    Returns {"commands", "attempts", "retries", "failures",
+    "probe_nonzero", "timeouts", "injected", "dry_run", "by_verb":
+    {verb: {"attempts", "failures", "retries", "total_duration_ms"}}} —
+    "commands" counts final attempts (one per executor.run call),
+    "failures" final attempts of CHECKED commands that still failed.
+    A nonzero rc from a check=False command (e.g. the ``kill -0``
+    liveness probe of a dead worker) is an observation, not a control-
+    plane failure — it lands in "probe_nonzero" instead, so
+    ``failures == 0`` keeps meaning "nothing unexpected happened".
+    """
+    records = load_journal(path)
+    by_verb: dict[str, dict[str, float]] = {}
+    summary: dict[str, Any] = {"commands": 0, "attempts": 0, "retries": 0,
+                               "failures": 0, "probe_nonzero": 0,
+                               "timeouts": 0, "injected": 0,
+                               "dry_run": 0, "by_verb": by_verb}
+    for rec in records:
+        verb = rec.get("verb", "?")
+        v = by_verb.setdefault(verb, {"attempts": 0, "failures": 0,
+                                      "retries": 0, "total_duration_ms": 0.0})
+        if rec.get("dry_run"):
+            summary["dry_run"] += 1
+            continue
+        summary["attempts"] += 1
+        v["attempts"] += 1
+        v["total_duration_ms"] = round(
+            v["total_duration_ms"] + (rec.get("duration_ms") or 0.0), 3)
+        if rec.get("timed_out"):
+            summary["timeouts"] += 1
+        if rec.get("injected"):
+            summary["injected"] += 1
+        if rec.get("will_retry"):
+            summary["retries"] += 1
+            v["retries"] += 1
+        else:
+            summary["commands"] += 1  # final attempt of its run() call
+            ok = rec.get("rc") == 0 and not rec.get("timed_out")
+            if not ok:
+                if rec.get("check", True):
+                    summary["failures"] += 1
+                    v["failures"] += 1
+                else:
+                    summary["probe_nonzero"] += 1
+    return summary

@@ -42,6 +42,10 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+if not hasattr(pltpu, "CompilerParams"):
+    # pre-rename spelling (jax ≤ 0.4.x) of the same dataclass
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 _NEG_INF = -1e30  # finite: keeps exp() algebra NaN-free on padded rows
 
 _LANE = 128

@@ -27,6 +27,7 @@ from flax import struct
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..core import mesh as mesh_lib
 from ..core import prng
 from ..core.config import ExperimentConfig
 from ..core.mesh import Topology
@@ -515,7 +516,7 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         "applied": P(),
     }
     batch_spec = P(axis, seq_ax) if n_seq > 1 else P(axis)
-    sharded = jax.shard_map(
+    sharded = mesh_lib.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(state_specs, batch_spec, P(axis)),
         out_specs=(state_specs, metrics_specs))
@@ -613,7 +614,7 @@ def build_eval_step(model: Model, cfg: ExperimentConfig, topo: Topology):
         return (lax.psum(correct, axis), lax.psum(loss_sum, axis),
                 lax.psum(weight, axis))
 
-    sharded = jax.shard_map(
+    sharded = mesh_lib.shard_map(
         shard_fn, mesh=topo.mesh,
         in_specs=(pspec, P(axis)),
         out_specs=(P(), P(), P()))

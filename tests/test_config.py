@@ -7,6 +7,8 @@ import pytest
 from distributedmnist_tpu.core.config import (ConfigError, ExperimentConfig,
                                               parse_cli_overrides)
 
+pytestmark = pytest.mark.tier1
+
 
 def test_defaults_roundtrip():
     cfg = ExperimentConfig()

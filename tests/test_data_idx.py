@@ -14,6 +14,8 @@ import pytest
 from distributedmnist_tpu.core.config import DataConfig
 from distributedmnist_tpu.data import datasets as ds
 
+pytestmark = pytest.mark.tier1
+
 
 def _fixture_arrays(n_train=32, n_test=16, seed=0):
     rng = np.random.default_rng(seed)

@@ -1,0 +1,159 @@
+"""Fault-injected lifecycle tests: REAL worker processes, injected
+failures, and the recovery behaviour the execution layer promises
+(≙ the dead/slow-worker regime of arXiv:1604.00981 applied to the
+control plane; VERDICT gap #1's executed-process evidence).
+
+The worker payload is a cheap shell loop emitting ``train_log.jsonl``
+step records — the same observable surface as ``launch train``,
+without booting jax per worker — so every test here runs real
+subprocesses AND stays in the tier-1 budget. The jax-booting
+realization of the same lifecycle is the ``slow``-marked smoke in
+``test_cluster_exec.py``.
+
+Acceptance coverage:
+  (a) transient command failure recovered by retry/backoff within the
+      attempt budget,
+  (b) a mid-run worker kill is detected and surfaced by ``status()``,
+  (c) a ``run_until_step`` poll timeout still tears the cluster down;
+and every run leaves a parseable JSONL command journal.
+"""
+
+import json
+import time
+
+import pytest
+
+from distributedmnist_tpu.launch.cluster import (ClusterError,
+                                                 LocalClusterConfig,
+                                                 LocalProcessCluster,
+                                                 run_until_step,
+                                                 wait_until_step)
+from distributedmnist_tpu.launch.exec import (CommandExecutor, FaultPlan,
+                                              RetryPolicy)
+from distributedmnist_tpu.obsv.journal import load_journal, summarize_journal
+
+pytestmark = pytest.mark.tier1
+
+# ~50 ms per step, 400 steps: outlives every test's observation window
+# without leaving long-lived orphans if a teardown assert fails
+_STEP_LOOP = ('i=0; while [ $i -lt 400 ]; do i=$((i+1)); '
+              'echo "{\\"step\\": $i, \\"loss\\": 1.0}" >> train_log.jsonl; '
+              'sleep 0.05; done')
+
+
+def _cluster(tmp_path, train_command=_STEP_LOOP, num_workers=2,
+             fault_plan=None, retry=None) -> LocalProcessCluster:
+    cfg = LocalClusterConfig(name="fi", workdir=str(tmp_path / "cl"),
+                             num_workers=num_workers,
+                             train_command=train_command)
+    ex = CommandExecutor(journal=cfg.root / "command_journal.jsonl",
+                         retry=retry or RetryPolicy(max_attempts=1),
+                         fault_plan=fault_plan)
+    return LocalProcessCluster(cfg, ex)
+
+
+def _alive(cluster) -> dict[int, bool]:
+    return {w["worker"]: w["alive"] for w in cluster.status()["workers"]}
+
+
+def test_transient_create_failure_recovered_by_retry(tmp_path):
+    """(a) The fault plan fails the first 2 attempts of ``create``; the
+    retry budget absorbs them and the REAL mkdir then runs."""
+    c = _cluster(tmp_path,
+                 fault_plan=FaultPlan(fail_first={"create": 2}),
+                 retry=RetryPolicy(max_attempts=3, backoff_s=0.01,
+                                   jitter_frac=0.0))
+    c.create()
+    assert c.cfg.worker_dir(0).is_dir() and c.cfg.worker_dir(1).is_dir()
+    recs = [r for r in load_journal(c.exec.journal_path)
+            if r["verb"] == "create"]
+    assert [r["attempt"] for r in recs] == [1, 2, 3]
+    assert [r["injected"] for r in recs] == [True, True, False]
+    s = summarize_journal(c.exec.journal_path)
+    assert s["retries"] == 2 and s["failures"] == 0
+    c.delete()
+
+
+def test_midrun_worker_kill_surfaces_in_status(tmp_path):
+    """(b) The plan kills worker 1 once a poll observes step >= 3; the
+    next status() probe (a real ``kill -0`` per pid) reports it dead
+    while worker 0 keeps running — the loss the aggregation layer's
+    backup-worker policies exist for, observed at the execution layer."""
+    c = _cluster(tmp_path,
+                 fault_plan=FaultPlan(kill_worker_at_step={1: 3}))
+    c.create()
+    c.run_train()
+    try:
+        got = wait_until_step(c, target=6, poll_secs=0.1, timeout_secs=60.0)
+        assert got["step"] >= 6
+        time.sleep(0.2)  # let the killed pid be reaped
+        alive = _alive(c)
+        assert alive[0] is True and alive[1] is False
+        assert c.status()["idle"] is False  # worker 0 still training
+        # load_journal filters event=command; read raw for fault events
+        raw = [json.loads(line) for line in
+               c.exec.journal_path.read_text().splitlines()]
+        faults = [r for r in raw if r.get("event") == "fault"]
+        assert faults and faults[0]["action"] == "kill_worker"
+        assert faults[0]["worker"] == 1 and faults[0]["at_step"] >= 3
+    finally:
+        c.kill_all()
+    time.sleep(0.2)
+    assert not any(_alive(c).values())
+    c.delete()
+    assert summarize_journal(c.exec.journal_path)["failures"] == 0
+
+
+def test_run_until_step_poll_timeout_tears_cluster_down(tmp_path):
+    """(c) A run that never reaches the target step times out — and the
+    finally-path still kills every worker: a hung run must not leave
+    processes (on a cloud backend: billing) behind."""
+    stall = ('echo "{\\"step\\": 1, \\"loss\\": 1.0}" >> train_log.jsonl; '
+             'sleep 60')
+    c = _cluster(tmp_path, train_command=stall)
+    c.create()
+    with pytest.raises(ClusterError, match=r"step 100.*last seen: 1"):
+        run_until_step(c, target=100, poll_secs=0.1, timeout_secs=1.0)
+    time.sleep(0.2)
+    assert not any(_alive(c).values())  # torn down on the error path
+    assert c.status()["idle"] is True
+    # the journal alone reconstructs the episode: spawns, polls, kills
+    raw = [json.loads(line) for line in
+           c.exec.journal_path.read_text().splitlines()]
+    assert sum(r.get("event") == "spawn" for r in raw) == 2
+    verbs = {r["verb"] for r in raw if r.get("event") == "command"}
+    assert {"create", "poll", "kill"} <= verbs
+    assert summarize_journal(c.exec.journal_path)["attempts"] >= 4
+    c.delete()
+
+
+def test_dead_cluster_fails_fast_not_at_poll_timeout(tmp_path):
+    """Workers that crash on boot (here: exit immediately) must fail
+    the wait NOW — without this, a dead cluster spins at step -1 until
+    the poll timeout (24 h by default on the CLI)."""
+    c = _cluster(tmp_path, train_command="true")
+    c.create()
+    c.run_train()
+    time.sleep(0.3)  # let both workers exit
+    t0 = time.monotonic()
+    with pytest.raises(ClusterError, match="no live workers"):
+        wait_until_step(c, target=5, poll_secs=0.1, timeout_secs=300.0)
+    assert time.monotonic() - t0 < 30  # far from the 300 s timeout
+    c.delete()
+
+
+def test_command_class_delay_straggles_the_poll(tmp_path):
+    """The straggler knob: delaying the ``poll`` class stretches the
+    observed poll latency without failing anything — the slow-worker
+    half of the arXiv:1604.00981 regime, on the control plane."""
+    c = _cluster(tmp_path, fault_plan=FaultPlan(delay_ms={"poll": 120.0}))
+    c.create()
+    (c.cfg.worker_dir(0) / "train_log.jsonl").write_text('{"step": 9}\n')
+    t0 = time.monotonic()
+    got = c.poll()
+    dt = time.monotonic() - t0
+    assert got["step"] == 9 and dt >= 0.12
+    recs = [r for r in load_journal(c.exec.journal_path)
+            if r["verb"] == "poll"]
+    assert recs[0]["injected_delay_ms"] == 120.0
+    c.delete()

@@ -10,6 +10,8 @@ from jax.sharding import PartitionSpec as P
 
 from distributedmnist_tpu.ops.masked_psum import masked_mean_psum
 
+pytestmark = pytest.mark.tier1
+
 
 def run_sharded(topo, fn, *args, in_specs, out_specs):
     return jax.jit(jax.shard_map(fn, mesh=topo.mesh, in_specs=in_specs,

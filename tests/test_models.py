@@ -57,6 +57,17 @@ def test_cnn_loss_matches_manual_xent():
     assert got == pytest.approx(want, rel=1e-6)
 
 
+def test_cnn_predictions_softmax_parity():
+    """≙ tf.nn.softmax export (src/mnist.py:166-167): rows are proper
+    distributions and exp-normalized logits."""
+    logits = jnp.array([[2.0, 1.0, 0.1], [0.5, 2.5, 0.2]])
+    probs = np.asarray(cnn.predictions(logits))
+    np.testing.assert_allclose(probs.sum(axis=-1), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(
+        probs, np.exp(logits) / np.exp(logits).sum(-1, keepdims=True),
+        rtol=1e-6)
+
+
 def test_cnn_accuracy():
     logits = jnp.array([[2.0, 1.0], [0.1, 3.0], [5.0, 0.0], [0.0, 1.0]])
     labels = jnp.array([0, 1, 1, 1])

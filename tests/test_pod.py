@@ -3,11 +3,14 @@ orchestrator subcommands (tools/tf_ec2.py:828-856), exercised through
 the dry-run seam — no gcloud needed."""
 
 import json
+import os
 
 import pytest
 
 from distributedmnist_tpu.launch.pod import (PodConfig, PodError, PodManager,
                                              Runner)
+
+pytestmark = pytest.mark.tier1
 
 
 def _mgr(**cfg_kw):
@@ -113,7 +116,7 @@ class _ScriptedRunner(Runner):
         super().__init__(dry_run=False)
         self.tails = list(tails)
 
-    def run(self, argv, check=True, capture=False):
+    def run(self, argv, check=True, capture=False, **kw):
         self.recorded.append(list(argv))
         cmd = argv[-1]
         if "tail -n 1" in cmd:
@@ -140,6 +143,132 @@ def test_wait_until_step_times_out_with_last_seen():
                    _ScriptedRunner([json.dumps({"step": 7})] * 50))
     with pytest.raises(PodError, match=r"step 100.*last seen: 7"):
         m.wait_until_step(100, poll_secs=0.0, timeout_secs=0.0)
+
+
+# ---------------------------------------------------------------------------
+# stubbed `gcloud` on PATH: the same verbs as EXECUTED processes — every
+# PodManager action below goes through a real subprocess.run of a real
+# `gcloud` executable (a recording stub), no dry-run, no mocks
+# (VERDICT gap #1's "stubbed gcloud smoke" recipe)
+# ---------------------------------------------------------------------------
+
+_GCLOUD_STUB = r"""#!/bin/sh
+# Recording gcloud stub: append each invocation, answer the verbs the
+# pod layer uses, optionally fail the first $GCLOUD_STUB_FAIL_FIRST
+# calls (transient-outage rehearsal).
+log="${GCLOUD_STUB_LOG:?}"
+printf '%s\n' "$*" >> "$log"
+if [ -n "${GCLOUD_STUB_FAIL_FIRST:-}" ] \
+   && [ "$(wc -l < "$log")" -le "$GCLOUD_STUB_FAIL_FIRST" ]; then
+    echo "stub: injected transient failure" >&2
+    exit 1
+fi
+case "$*" in
+  *" describe "*)  echo '{"state": "READY"}' ;;
+  *"pgrep -c"*)    echo 0 ;;
+  *"tail -n 1"*)   cat "${GCLOUD_STUB_POLL:-/dev/null}" 2>/dev/null ;;
+esac
+exit 0
+"""
+
+
+@pytest.fixture()
+def gcloud_stub(tmp_path, monkeypatch):
+    """Install a recording `gcloud` at the front of PATH; returns the
+    invocation log path."""
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    stub = bindir / "gcloud"
+    stub.write_text(_GCLOUD_STUB)
+    stub.chmod(0o755)
+    log = tmp_path / "gcloud_calls.log"
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    monkeypatch.setenv("GCLOUD_STUB_LOG", str(log))
+    return log
+
+
+def _live_mgr(tmp_path, **runner_kw):
+    cfg = PodConfig(name="t", zone="z", project="p",
+                    remote_outdir="/tmp/out")
+    runner = Runner(journal=tmp_path / "journal.jsonl", **runner_kw)
+    return PodManager(cfg, runner)
+
+
+def test_stubbed_gcloud_full_lifecycle_executes(tmp_path, monkeypatch,
+                                                gcloud_stub):
+    """create → run → status → poll → download → delete, each verb a
+    REAL subprocess.run of the PATH `gcloud` — the executed-process
+    coverage the dry-run argv tests never had."""
+    from distributedmnist_tpu.obsv.journal import summarize_journal
+    poll_file = tmp_path / "poll.json"
+    poll_file.write_text(json.dumps({"step": 120, "loss": 0.2}) + "\n")
+    monkeypatch.setenv("GCLOUD_STUB_POLL", str(poll_file))
+    m = _live_mgr(tmp_path)
+
+    m.create()
+    m.run_train()
+    got = m.status()
+    assert got["state"] == "READY" and got["idle"] is True
+    assert m.poll() == {"step": 120, "record": {"step": 120, "loss": 0.2}}
+    dest = tmp_path / "dl"
+    m.download(dest)
+    assert dest.is_dir()  # local side effect; the scp itself is stubbed
+    m.delete()
+
+    calls = gcloud_stub.read_text().splitlines()
+    for want in ("compute tpus tpu-vm create t",
+                 "compute tpus tpu-vm delete t",
+                 "compute tpus tpu-vm describe t",
+                 "compute tpus tpu-vm scp"):
+        assert any(want in c for c in calls), want
+    ssh_cmds = [c for c in calls if " ssh " in f" {c} "]
+    assert any("nohup" in c for c in ssh_cmds)       # run_train
+    assert any("pgrep -c" in c for c in ssh_cmds)    # status probe
+    assert any("tail -n 1" in c for c in ssh_cmds)   # poll
+    s = summarize_journal(m.runner.journal_path)
+    assert s["failures"] == 0 and s["commands"] == len(calls)
+
+
+def test_stubbed_gcloud_run_until_step_stops_run(tmp_path, monkeypatch,
+                                                 gcloud_stub):
+    """The benchmark-driver shape against executed processes: launch,
+    poll the (scripted) remote log past the target, kill."""
+    poll_file = tmp_path / "poll.json"
+    poll_file.write_text(json.dumps({"step": 500}) + "\n")
+    monkeypatch.setenv("GCLOUD_STUB_POLL", str(poll_file))
+    m = _live_mgr(tmp_path)
+    got = m.run_until_step(500, poll_secs=0.0)
+    assert got["step"] == 500
+    calls = gcloud_stub.read_text().splitlines()
+    assert any("nohup" in c for c in calls)
+    assert any("pkill" in c for c in calls)  # stopped at the target
+
+
+def test_stubbed_gcloud_transient_failure_recovered_by_retry(
+        tmp_path, monkeypatch, gcloud_stub):
+    """A gcloud outage of 2 REAL nonzero-rc invocations is absorbed by
+    the runner's retry budget; the third executes clean."""
+    from distributedmnist_tpu.launch.exec import RetryPolicy
+    from distributedmnist_tpu.obsv.journal import load_journal
+    monkeypatch.setenv("GCLOUD_STUB_FAIL_FIRST", "2")
+    m = _live_mgr(tmp_path,
+                  retry=RetryPolicy(max_attempts=3, backoff_s=0.01,
+                                    jitter_frac=0.0))
+    m.delete()
+    assert len(gcloud_stub.read_text().splitlines()) == 3
+    recs = load_journal(m.runner.journal_path)
+    assert [r["attempt"] for r in recs] == [1, 2, 3]
+    assert [r["rc"] for r in recs] == [1, 1, 0]
+
+
+def test_stubbed_gcloud_exhausted_retries_is_pod_error(tmp_path, monkeypatch,
+                                                       gcloud_stub):
+    monkeypatch.setenv("GCLOUD_STUB_FAIL_FIRST", "99")
+    from distributedmnist_tpu.launch.exec import RetryPolicy
+    m = _live_mgr(tmp_path, retry=RetryPolicy(max_attempts=2, backoff_s=0.0,
+                                              jitter_frac=0.0))
+    with pytest.raises(PodError, match="after 2 attempt"):
+        m.create()
 
 
 def test_cli_dry_run_prints_commands(capsys):

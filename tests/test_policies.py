@@ -1,6 +1,8 @@
 """Contribution-mask policy tests (≙ the reference's three aggregation
 disciplines, SURVEY §2.2, as pure mask math)."""
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -9,6 +11,8 @@ from jax.sharding import PartitionSpec as P
 from distributedmnist_tpu.core import prng
 from distributedmnist_tpu.core.config import SyncConfig
 from distributedmnist_tpu.parallel import policies
+
+pytestmark = pytest.mark.tier1
 
 
 def _flags_for_times(topo8, times, k):

@@ -11,6 +11,8 @@ import pytest
 from conftest import base_config
 from distributedmnist_tpu.obsv import report as rpt
 
+pytestmark = pytest.mark.tier1
+
 
 @pytest.fixture(scope="module")
 def run_dirs(tmp_path_factory):

@@ -8,6 +8,8 @@ import pytest
 
 from distributedmnist_tpu.obsv import tb
 
+pytestmark = pytest.mark.tier1
+
 
 def _read_events(log_dir):
     """All (step, {tag: value}) records via tensorboard's own loader."""
