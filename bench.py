@@ -559,16 +559,140 @@ def bench_native_loader() -> dict:
                       "reader kept for C-ABI tests)"}})
 
 
+def bench_input_pipeline_overlap() -> dict:
+    """Dispatch-ahead input pipeline: a deliberately slow host loader
+    feeding the flagship CNN step, sync-feed (next → device_put →
+    dispatch → drain, serial) vs prefetch-feed (DevicePrefetcher at the
+    production depth). The loader's per-batch cost is calibrated to the
+    measured step wall, so a working overlap reads ~2× and the gate is
+    ≥ 1.5× batches/sec. The consumer drains every step — the shape
+    where the host's serial feed is fully exposed (and what a
+    metrics-hungry policy loop looks like); the interleaved-repeat
+    median gates it, as in bench_mode_overhead."""
+    from distributedmnist_tpu.core.config import DataConfig
+    from distributedmnist_tpu.data.datasets import make_synthetic
+    from distributedmnist_tpu.data.device_prefetch import DevicePrefetcher
+
+    n_dev = len(jax.devices())
+    # the gate is a RATIO of feed disciplines, not a throughput anchor:
+    # keep the step light on CPU meshes (8 virtual devices over a
+    # couple of real cores turn a big conv step into multi-second
+    # rendezvous), full-size on a real accelerator
+    per_dev = 64 if jax.default_backend() == "cpu" else 2048
+    batch = per_dev * max(1, n_dev)
+    cfg, topo, model, state, step_fn = _build({
+        "data": {"dataset": "synthetic", "batch_size": batch},
+        "model": {"compute_dtype": "bfloat16"},
+        "sync": {"mode": "sync"},
+    })
+    ds = make_synthetic(num_train=batch, num_test=64)
+    host_batch = {"image": ds.train.images[:batch],
+                  "label": ds.train.labels[:batch]}
+
+    # compile + warm, then calibrate the per-step wall (dispatch +
+    # drain) the slow loader is matched against
+    state, m = step_fn(state, topo.device_put_batch(host_batch))
+    _drain(m)
+    calib = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        state, m = step_fn(state, topo.device_put_batch(host_batch))
+        float(m["loss"])
+        calib.append(time.perf_counter() - t0)
+    exec_s = statistics.median(calib)
+    # loader cost ≈ step cost maximizes the visible overlap (expected
+    # ~2×); the floor keeps sleep() resolution out of the measurement
+    sleep_s = max(exec_s, 0.002)
+
+    class SlowLoader:
+        """Stand-in for an expensive host stage (decode / augment /
+        assembly): sleep-dominated, so the cost is overlappable
+        wherever a producer thread can run — exactly what the
+        prefetcher must exploit."""
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            time.sleep(sleep_s)
+            return host_batch
+
+    depth = DataConfig().device_prefetch_depth
+    n_batches, n_repeats = 12, 3
+
+    def run_arm(prefetched: bool, st):
+        loader = SlowLoader()
+        feed = (DevicePrefetcher(loader, put=topo.device_put_batch,
+                                 depth=depth) if prefetched else None)
+        try:
+            t0 = time.perf_counter()
+            for _ in range(n_batches):
+                g = next(feed) if prefetched else topo.device_put_batch(
+                    next(loader))
+                st, m = step_fn(st, g)
+                float(m["loss"])  # drain: expose the feed fully
+            dt = time.perf_counter() - t0
+        finally:
+            if feed is not None:
+                feed.close()
+        return n_batches / dt, st
+
+    rates: dict[str, list[float]] = {"sync": [], "prefetch": []}
+    for _ in range(n_repeats):  # interleaved: drift lands on both arms
+        for name, pf in (("sync", False), ("prefetch", True)):
+            rate, state = run_arm(pf, state)
+            rates[name].append(rate)
+
+    med = {k: statistics.median(v) for k, v in rates.items()}
+    speedup = med["prefetch"] / med["sync"]
+    return {
+        "metric": "input_pipeline_overlap_speedup",
+        "value": round(speedup, 2), "unit": "x (prefetch/sync batches/sec)",
+        "meets_1p5x_gate": bool(speedup >= 1.5),
+        "detail": {
+            "gate": f"median of {n_repeats} interleaved repeats ≥ 1.5x",
+            "step_wall_ms": round(exec_s * 1e3, 2),
+            "loader_ms_per_batch": round(sleep_s * 1e3, 2),
+            "prefetch_depth": depth, "batch": batch,
+            "batches_per_sec": {k: [round(r, 2) for r in v]
+                                for k, v in rates.items()},
+            "expected_upper_bound_x": round(
+                (sleep_s + exec_s) / max(sleep_s, exec_s), 2),
+            **_env_stamp()}}
+
+
 def main() -> None:
     """Run every case, then print the ONE self-contained artifact line
     on stdout, LAST — the driver keeps the tail of the output, so
     last-wins is what makes the artifact survive capture (VERDICT weak
-    #2: headline-first + cases-on-stderr lost the cnn headline)."""
-    headline = bench_cnn_sync()
-    _case(headline)  # stderr progress; stdout stays reserved for the end
+    #2: headline-first + cases-on-stderr lost the cnn headline).
+
+    ``DMT_BENCH_CASES`` (comma-separated substrings of case-function
+    names) selects a subset — what lets CI afford an artifact on CPU
+    runners, where the full flash/pallas cases are minutes-scale. The
+    artifact notes the filter so a subset can never pass for a full run.
+    """
+    import os
+
+    only = {s.strip() for s in os.environ.get("DMT_BENCH_CASES",
+                                              "").split(",") if s.strip()}
+
+    def want(fn) -> bool:
+        return not only or any(k in fn.__name__ for k in only)
+
+    if want(bench_cnn_sync):
+        headline = bench_cnn_sync()
+        _case(headline)  # stderr progress; stdout reserved for the end
+    else:
+        headline = {"metric": "bench_subset", "value": None, "unit": None,
+                    "vs_baseline": None,
+                    "subset": sorted(only)}
     cases: list[dict] = []
     for case in (bench_transformer_flash, bench_flash_long_context,
-                 bench_mode_overhead, bench_native_loader):
+                 bench_mode_overhead, bench_native_loader,
+                 bench_input_pipeline_overlap):
+        if not want(case):
+            continue
         try:
             got = case()
         except Exception as e:  # a failed case must not kill the headline
@@ -577,7 +701,30 @@ def main() -> None:
         for record in got if isinstance(got, list) else [got]:
             _case(record)
             cases.append(record)
-    print(json.dumps({**headline, "cases": cases},
+    # regression guard: the headline ratchets (every case carrying a
+    # vs_baseline anchor: CNN, transformer flash, long-context flash)
+    # must not move down while the overlap case moves up (ISSUE 2
+    # acceptance) — surfaced as one field instead of leaving the
+    # reader to scan cases. `ok` is vs the PUBLISHED round-1 anchor
+    # (the repo's ratchet mechanism); round-over-round trajectory
+    # lives in the BENCH_r* history, not here.
+    anchored_fns = ("bench_transformer_flash", "bench_flash_long_context")
+    guarded = [headline] + [
+        c for c in cases
+        # a CRASHED anchor case records {"metric": fn_name, "error":..}
+        # with no vs_baseline — it must appear here as not-ok, not
+        # silently vanish from the guard
+        if "vs_baseline" in c or c.get("metric") in anchored_fns]
+    guard = {
+        "threshold": "vs_baseline >= 0.9 of the published anchor",
+        "cases": [{"metric": c.get("metric"),
+                   "vs_baseline": c.get("vs_baseline"),
+                   "ok": (False if "error" in c
+                          else None if c.get("vs_baseline") is None
+                          else bool(c["vs_baseline"] >= 0.9))}
+                  for c in guarded]}
+    print(json.dumps({**headline, "cases": cases,
+                      "headline_regression_guard": guard},
                      separators=(",", ":")))
 
 

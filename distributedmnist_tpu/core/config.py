@@ -46,6 +46,23 @@ class DataConfig:
     synthetic_test_size: int = 2048
     use_native_pipeline: bool = True  # C++ prefetch loader when built
     prefetch_batches: int = 2
+    # Device-side prefetch (data.device_prefetch): stage batches
+    # through Topology.device_put_batch on a producer thread, a
+    # bounded queue of device_prefetch_depth ahead of the consuming
+    # step — host assembly + H2D overlap device compute instead of
+    # sitting on its critical path (data/device_prefetch.py). Enabled
+    # by default where a producer thread pays: a spare host core, or a
+    # real accelerator backend whose drains park the host GIL-free
+    # (single-core CPU-backend hosts fall back to the inline feed, per
+    # the same measurement behind the native-pipeline gate).
+    device_prefetch: bool = True
+    device_prefetch_depth: int = 2
+
+    def effective_device_prefetch_depth(self) -> int:
+        """The depth eval paths should stage ahead — 0 (inline feed)
+        whenever the enable knob is off. One definition, so Trainer
+        eval and the evaluator service can't drift."""
+        return self.device_prefetch_depth if self.device_prefetch else 0
     # Fetch missing idx files into data_dir before loading
     # (≙ maybe_download, src/mnist_data.py:176-187). Degrades to the
     # synthetic fallback when there is no network egress.

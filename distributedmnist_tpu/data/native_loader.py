@@ -119,6 +119,15 @@ class NativePrefetcher:
             _LIB.dml_loader_destroy(self._handle)
             self._handle = None
 
+    def __enter__(self) -> "NativePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        # same with-block lifecycle as data.device_prefetch — the two
+        # stages compose (C++ assembles k+2 while the device stage
+        # uploads k+1), so they should tear down the same way too
+        self.close()
+
     def __del__(self):
         try:
             self.close()

@@ -130,15 +130,39 @@ def eval_batches(data: ArrayDataset, batch_size: int, pad_multiple: int = 1,
         yield {"image": x, "label": y, "weight": w}
 
 
-def make_train_iterator(data: ArrayDataset, cfg: DataConfig, seed: int,
-                        host_id: int = 0, num_hosts: int = 1) -> BatchIterator:
+def host_can_spare_producer_thread() -> bool:
+    """One shared gate for every producer-thread optimization (the
+    native C++ prefetcher below, the device-side ``DevicePrefetcher``):
+    a producer thread needs a SPARE core. On a 1-core host it only
+    fights the consumer for the one core — measured as a net slowdown
+    (see the native gate's numbers below). Turn the knobs off
+    explicitly (``data.use_native_pipeline`` /
+    ``data.device_prefetch``) to override in the other direction."""
     import os
 
+    return (os.cpu_count() or 1) >= 2
+
+
+def device_prefetch_pays() -> bool:
+    """Gate for the DEVICE-side prefetch stage specifically (train
+    loop and eval share this one policy): a spare host core, OR a real
+    accelerator backend — there the consumer's device drains park the
+    host GIL-free, which is exactly when a producer thread gets its
+    cycles even on one core. Single-core CPU-backend hosts feed
+    inline (same measurement as the gate above)."""
+    import jax
+
+    return (host_can_spare_producer_thread()
+            or jax.default_backend() != "cpu")
+
+
+def make_train_iterator(data: ArrayDataset, cfg: DataConfig, seed: int,
+                        host_id: int = 0, num_hosts: int = 1) -> BatchIterator:
     it = BatchIterator(data, cfg.batch_size, seed=seed, host_id=host_id,
                        num_hosts=num_hosts, shard_mode=cfg.shard_mode)
     if cfg.use_native_pipeline:
         from ..core.log import get_logger
-        if (os.cpu_count() or 1) < 2:
+        if not host_can_spare_producer_thread():
             # a prefetch thread can only fight the consumer for the one
             # core — measured as a net slowdown by bench_native_loader
             # under BOTH consumer shapes: cpu-busy (~0.6x) AND the

@@ -137,8 +137,12 @@ class Evaluator:
         state, _, at_step = restored
         specs = state_partition_specs(self.model, self.cfg, self.topo)
         params = self.topo.device_put_state(state.params, specs.params)
-        out = run_full_eval(self.eval_fn, params, self.topo,
-                            self.datasets.test, self.eval_cfg.eval_batch_size)
+        out = run_full_eval(
+            self.eval_fn, params, self.topo,
+            self.datasets.test, self.eval_cfg.eval_batch_size,
+            # honor the run's staging knobs — the same off-switch the
+            # Trainer's eval respects
+            prefetch_depth=self.cfg.data.effective_device_prefetch_depth())
         result = {
             "event": "eval", "step": at_step, "time": time.time(),
             "num_examples": out["num_examples"],

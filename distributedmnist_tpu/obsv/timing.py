@@ -67,12 +67,16 @@ class StepTimeCollector:
         self._raw: list[Any] = []
         self._materialized = 0  # prefix of _raw already fetched to host
         self._host_steps: list[float] = []  # host-measured wall per step
+        self._prefetch_depths: list[int] = []  # staged-queue gauge per step
 
-    def add(self, per_replica_times: Any, host_step_seconds: float | None = None) -> None:
+    def add(self, per_replica_times: Any, host_step_seconds: float | None = None,
+            prefetch_depth: int | None = None) -> None:
         if len(self._raw) < self.capacity:
             self._raw.append(per_replica_times)
         if host_step_seconds is not None and len(self._host_steps) < self.capacity:
             self._host_steps.append(host_step_seconds)
+        if prefetch_depth is not None and len(self._prefetch_depths) < self.capacity:
+            self._prefetch_depths.append(int(prefetch_depth))
 
     def matrix(self) -> np.ndarray:
         """[steps, n_replicas] materialized compute times.
@@ -102,19 +106,31 @@ class StepTimeCollector:
     def host_step_stats(self) -> CdfStats:
         return compute_stats(np.asarray(self._host_steps))
 
+    def prefetch_depth_stats(self) -> CdfStats:
+        """Distribution of the device-prefetch queue depth sampled at
+        each step's dequeue: pinned at 0 means the producer (host
+        assembly + H2D) is the bottleneck; pinned at the configured
+        depth means the device is — the one gauge that says which side
+        of the overlap to optimize next."""
+        return compute_stats(np.asarray(self._prefetch_depths, np.float64))
+
     def report(self) -> dict[str, Any]:
         per_replica = self.per_replica_stats()
-        return {
+        out = {
             "num_steps": len(self._raw),
             "per_replica": [s.to_dict() for s in per_replica],
             "barrier": self.per_step_stats().to_dict(),
             "host_wall": self.host_step_stats().to_dict(),
         }
+        if self._prefetch_depths:
+            out["prefetch_queue_depth"] = self.prefetch_depth_stats().to_dict()
+        return out
 
     def reset(self) -> None:
         self._raw.clear()
         self._materialized = 0
         self._host_steps.clear()
+        self._prefetch_depths.clear()
 
 
 class ReplicaDeviceProbe:

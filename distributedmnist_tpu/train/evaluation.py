@@ -17,14 +17,20 @@ import jax
 import numpy as np
 
 from ..core.mesh import Topology
-from ..data.pipeline import eval_batches
+from ..data.device_prefetch import DevicePrefetcher
+from ..data.pipeline import device_prefetch_pays, eval_batches
 
 
 def run_full_eval(eval_fn: Callable, params: Any, topo: Topology, data,
-                  batch_size: int = 0) -> dict[str, float]:
+                  batch_size: int = 0, prefetch_depth: int = 2) -> dict[str, float]:
     """Evaluate ``params`` on the whole split; returns accuracy / loss /
     num_examples / seconds. ``batch_size`` 0 picks a throughput-friendly
-    default (≤4096, ≥1 row per replica)."""
+    default (≤4096, ≥1 row per replica).
+
+    Batches ride the same dispatch-ahead staging as the train loop:
+    padding/assembly + H2D for batch *k+1* overlap the eval step on
+    batch *k* (``prefetch_depth`` staged ahead; 0 feeds inline — also
+    the automatic fallback where a producer thread can't pay)."""
     n = topo.num_replicas
     hosts = jax.process_count()
     bs = batch_size or max(n, min(4096, data.num_examples))
@@ -33,13 +39,27 @@ def run_full_eval(eval_fn: Callable, params: Any, topo: Topology, data,
     num_examples = 0.0  # counted from batch weights: for LM models the
     # eval_fn weight sum is a TOKEN count (lm_eval_metrics), which is
     # the right normalizer for loss/accuracy but not an example count.
-    for batch in eval_batches(data, bs, pad_multiple=max(1, n // hosts),
-                              host_id=jax.process_index(), num_hosts=hosts):
-        num_examples += float(batch["weight"].sum())
-        c, l, w = eval_fn(params, topo.device_put_batch(batch))
-        correct += float(c)
-        loss_sum += float(l)
-        weight += float(w)
+
+    def _stage(batch: dict):
+        # host-side weight sum rides along: the consumer must never
+        # touch the (asynchronously staged) device array for it
+        return float(batch["weight"].sum()), topo.device_put_batch(batch)
+
+    raw = eval_batches(data, bs, pad_multiple=max(1, n // hosts),
+                       host_id=jax.process_index(), num_hosts=hosts)
+    use_prefetch = prefetch_depth > 0 and device_prefetch_pays()
+    feed = (DevicePrefetcher(raw, put=_stage, depth=prefetch_depth)
+            if use_prefetch else map(_stage, raw))
+    try:
+        for wsum, gbatch in feed:
+            num_examples += wsum
+            c, l, w = eval_fn(params, gbatch)
+            correct += float(c)
+            loss_sum += float(l)
+            weight += float(w)
+    finally:
+        if use_prefetch:
+            feed.close()
     if hosts > 1:
         # each host only iterated its stripe of the split
         from jax.experimental import multihost_utils

@@ -22,7 +22,8 @@ from ..core.config import ExperimentConfig
 from ..core.log import JsonlSink, get_logger, step_line
 from ..core.mesh import Topology, make_topology
 from ..data.datasets import Datasets, load_datasets
-from ..data.pipeline import make_train_iterator
+from ..data.device_prefetch import DevicePrefetcher
+from ..data.pipeline import device_prefetch_pays, make_train_iterator
 from .evaluation import run_full_eval
 from ..models.registry import Model, get_model
 from ..obsv.timing import StepTimeCollector
@@ -91,6 +92,25 @@ class Trainer:
             self.datasets.train, cfg.data, seed=cfg.train.seed,
             host_id=jax.process_index(), num_hosts=jax.process_count())
 
+        # Dispatch-ahead feed: batches staged through device_put_batch
+        # on a producer thread, device_prefetch_depth ahead, so host
+        # assembly + H2D overlap device compute instead of sitting on
+        # its critical path (data/device_prefetch.py). One shared
+        # policy for when the producer thread pays: data.pipeline.
+        # device_prefetch_pays (spare core, or an accelerator backend
+        # whose drains park the host GIL-free).
+        self._device_prefetch = (cfg.data.device_prefetch
+                                 and cfg.data.device_prefetch_depth > 0
+                                 and device_prefetch_pays())
+        self._train_feed: DevicePrefetcher | None = None
+
+        # Measured-timing vector staging: validate once, reuse the
+        # sharding + host assembly buffer every step (core/mesh.py
+        # MeasuredStage) instead of rebuilding both per step.
+        self._measured_stage = (self.topo.measured_stage()
+                                if self.topo.measured_timing_supported
+                                else None)
+
         self.collector = StepTimeCollector(num_replicas=n)
         # Test/fault-injection seam: extra per-LOCAL-replica delay (ms)
         # added onto the measured vector — lets tests (and chaos runs)
@@ -144,6 +164,37 @@ class Trainer:
 
     # ------------------------------------------------------------------
 
+    @property
+    def train_feed(self):
+        """The dispatch-ahead feed over the CURRENT ``train_iter`` —
+        the DevicePrefetcher when enabled, the raw iterator otherwise.
+        Resolved lazily so the established seam of swapping
+        ``trainer.train_iter`` after construction (tests, chaos
+        harnesses injecting a slow ingest) keeps working: a swap makes
+        the previous wrapper stale and a fresh one is built around the
+        new iterator.
+
+        One documented limit: a swapped-in iterator with NO
+        state()/restore() supports a single run() — the end-of-run
+        stop() cannot push its read-ahead back into such an iterator,
+        so the wrapper closes (loudly, at the next next()) rather than
+        resume with a silent hole in the batch stream."""
+        if not self._device_prefetch:
+            return self.train_iter
+        if (self._train_feed is None
+                or self._train_feed.inner is not self.train_iter):
+            if self._train_feed is not None:
+                # join the stale wrapper's producer now — left to GC it
+                # would keep consuming the old iterator (and hold its
+                # cursor at the read-ahead position) indefinitely
+                self._train_feed.stop()
+            self._train_feed = DevicePrefetcher(
+                self.train_iter,
+                put=lambda b: self.topo.device_put_batch(
+                    b, seq_sharded=self.seq_sharded),
+                depth=self.cfg.data.device_prefetch_depth)
+        return self._train_feed
+
     def _maybe_resume(self) -> None:
         restored = ckpt.restore_checkpoint(self.train_dir, self.state)
         if restored is None:
@@ -168,8 +219,12 @@ class Trainer:
         self.state = self.topo.device_put_state(state, self.state_specs)
         if "data_iter" in extra:
             try:
-                self.train_iter.restore(extra["data_iter"])
-            except (AttributeError, KeyError, ValueError):
+                # through the feed: a prefetching feed must also drop
+                # anything it staged ahead of the restored cursor
+                # (RuntimeError: DevicePrefetcher over a non-restorable
+                # inner — same degrade-to-fresh-stream semantics)
+                self.train_feed.restore(extra["data_iter"])
+            except (AttributeError, KeyError, ValueError, RuntimeError):
                 logger.warning("could not restore data-iterator state; "
                                "restarting stream")
         self._start_step = int(jax.device_get(self.state.step))
@@ -185,9 +240,12 @@ class Trainer:
         if not self.is_writer and not ckpt.state_needs_sharded_save(self.state):
             return
         extra = {"config": self.cfg.to_dict()}
-        iter_state = getattr(self.train_iter, "state", None)
-        if callable(iter_state):
-            extra["data_iter"] = self.train_iter.state()
+        # through the feed: a prefetching feed reports the cursor of
+        # the last CONSUMED batch, not the producer's read-ahead
+        # position — a resume must replay batches the step never saw
+        iter_state = getattr(self.train_feed, "state", None)
+        if callable(iter_state) and getattr(self.train_feed, "has_state", True):
+            extra["data_iter"] = self.train_feed.state()
         at_step = int(jax.device_get(self.state.step))
         if self._use_async_ckpt:
             if self._checkpointer is None or self._checkpointer.closed:
@@ -231,9 +289,10 @@ class Trainer:
     def evaluate(self, split: str = "test") -> dict[str, float]:
         """One full-split eval pass (in-loop convenience; the
         continuous evaluator lives in ``evalsvc``)."""
-        return run_full_eval(self.eval_fn, self.state.params, self.topo,
-                             getattr(self.datasets, split),
-                             self.cfg.eval.eval_batch_size)
+        return run_full_eval(
+            self.eval_fn, self.state.params, self.topo,
+            getattr(self.datasets, split), self.cfg.eval.eval_batch_size,
+            prefetch_depth=self.cfg.data.effective_device_prefetch_depth())
 
     def run(self, max_steps: int | None = None,
             step_callback: Callable[[int, dict], None] | None = None) -> dict[str, Any]:
@@ -272,19 +331,22 @@ class Trainer:
                 "model only")
 
         def measured_vector() -> jax.Array | None:
-            if not can_measure or not (inject_measured
-                                       or self.delay_injection_ms is not None):
+            stage = self._measured_stage
+            if stage is None or not (inject_measured
+                                     or self.delay_injection_ms is not None):
                 return None
-            local = np.full(self.topo.local_replica_count,
-                            host_dt * 1000.0 if inject_measured else 0.0,
-                            np.float32)
+            # assemble into the stage's reusable buffer; put() reuses
+            # the cached sharding (and the staged all-zeros device
+            # buffer outright when nothing was injected or measured)
+            buf = stage.buffer
+            buf[:] = host_dt * 1000.0 if inject_measured else 0.0
             if self.delay_injection_ms is not None:
-                local = local + np.asarray(self.delay_injection_ms, np.float32)
+                buf += np.asarray(self.delay_injection_ms, np.float32)
             if self._last_device_skew is not None:
                 # per-device drain skew measured LAST step — the
                 # within-host divergence the uniform host dt misses
-                local = local + self._last_device_skew
-            return self.topo.device_put_measured(local)
+                buf += self._last_device_skew
+            return stage.put()
 
         def flush(now: float) -> None:
             nonlocal final_metrics, last_log_t, last_log_step
@@ -345,59 +407,87 @@ class Trainer:
                              "(profiler traces cannot nest)")
         tracing_step = None
 
+        # Dispatch-ahead: the feed (train_feed property) either hands
+        # back pre-staged sharded global arrays (DevicePrefetcher —
+        # host assembly and H2D ran on the producer thread while the
+        # previous step executed) or the raw host batch to stage
+        # inline. Re-resolved each iteration (two attribute compares)
+        # so the train_iter swap seam works mid-run too — the property
+        # joins a stale wrapper before handing back the fresh one.
+        prefetching = self._device_prefetch
+
         self.train_dir.mkdir(parents=True, exist_ok=True)
         step = self._start_step
-        while step < total:
-            in_window = profile_stop > profile_start and profile_start <= step < profile_stop
-            if in_window and not profiling and self.is_writer:
-                jax.profiler.start_trace(str(self.train_dir / "profile"))
-                profiling = True
-            if (trace_every and self.is_writer and tracing_step is None
-                    and step % trace_every == 0):
-                jax.profiler.start_trace(
-                    str(self.train_dir / "profile" / f"step_{step}"))
-                tracing_step = step
-            t0 = time.time()
-            batch = next(self.train_iter)
-            gbatch = self.topo.device_put_batch(batch,
-                                                seq_sharded=self.seq_sharded)
-            self.state, metrics = self.step_fn(self.state, gbatch,
-                                               measured_vector())
-            # host_dt is the per-HOST base time and must be captured
-            # BEFORE the probe's drain poll — otherwise one slow device
-            # would inflate every local replica's base (and the slow
-            # one's skew would double-count)
-            host_dt = time.time() - t0
-            if self._device_probe is not None:
-                if self.device_work_injection:
-                    for _r, (fn, arg) in self.device_work_injection.items():
-                        fn(arg)  # async: queues real work on that device
-                self._last_device_skew = self._device_probe.measure_skew_ms()
-            step += 1
-            self.collector.add(metrics["step_times_ms"], host_dt)
-            pending.append((step, metrics, time.time()))
+        try:
+            while step < total:
+                feed = self.train_feed
+                in_window = profile_stop > profile_start and profile_start <= step < profile_stop
+                if in_window and not profiling and self.is_writer:
+                    jax.profiler.start_trace(str(self.train_dir / "profile"))
+                    profiling = True
+                if (trace_every and self.is_writer and tracing_step is None
+                        and step % trace_every == 0):
+                    jax.profiler.start_trace(
+                        str(self.train_dir / "profile" / f"step_{step}"))
+                    tracing_step = step
+                t0 = time.time()
+                if prefetching:
+                    gbatch = next(feed)
+                    # gauge AT dequeue: sampled any later, the producer
+                    # has refilled and a producer-bound pipeline (the
+                    # "pinned at 0" reading) would look healthy
+                    queue_depth = feed.qsize
+                else:
+                    gbatch = self.topo.device_put_batch(
+                        next(feed), seq_sharded=self.seq_sharded)
+                self.state, metrics = self.step_fn(self.state, gbatch,
+                                                   measured_vector())
+                # host_dt is the per-HOST base time and must be captured
+                # BEFORE the probe's drain poll — otherwise one slow device
+                # would inflate every local replica's base (and the slow
+                # one's skew would double-count)
+                host_dt = time.time() - t0
+                if self._device_probe is not None:
+                    if self.device_work_injection:
+                        for _r, (fn, arg) in self.device_work_injection.items():
+                            fn(arg)  # async: queues real work on that device
+                    self._last_device_skew = self._device_probe.measure_skew_ms()
+                step += 1
+                self.collector.add(
+                    metrics["step_times_ms"], host_dt,
+                    prefetch_depth=queue_depth if prefetching else None)
+                pending.append((step, metrics, time.time()))
 
-            if tracing_step is not None:
-                # one full step per window; fetch a scalar first so the
-                # trace covers the device work, not just the dispatch
-                float(metrics["loss"])
-                jax.profiler.stop_trace()
-                tracing_step = None
+                if tracing_step is not None:
+                    # one full step per window; fetch a scalar first so the
+                    # trace covers the device work, not just the dispatch
+                    float(metrics["loss"])
+                    jax.profiler.stop_trace()
+                    tracing_step = None
 
-            if step % log_every == 0:
-                flush(time.time())
+                if step % log_every == 0:
+                    flush(time.time())
 
-            if profiling and step >= profile_stop:
-                jax.profiler.stop_trace()
-                profiling = False
+                if profiling and step >= profile_stop:
+                    jax.profiler.stop_trace()
+                    profiling = False
 
-            if cfg.save_interval_secs > 0:
-                if time.time() - self._last_save_time >= cfg.save_interval_secs:
+                if cfg.save_interval_secs > 0:
+                    if time.time() - self._last_save_time >= cfg.save_interval_secs:
+                        self._save(step)
+                elif cfg.save_interval_steps > 0 and step % cfg.save_interval_steps == 0:
                     self._save(step)
-            elif cfg.save_interval_steps > 0 and step % cfg.save_interval_steps == 0:
-                self._save(step)
-            if cfg.save_results_period > 0 and step % cfg.save_results_period == 0:
-                self._dump_series()
+                if cfg.save_results_period > 0 and step % cfg.save_results_period == 0:
+                    self._dump_series()
+        finally:
+            if self._train_feed is not None:
+                # normal exit OR an exception escaping the loop: join
+                # the producer and re-sync the inner cursor to the
+                # consumed position, so nothing holds the process open
+                # and a later run()/checkpoint observes no phantom
+                # read-ahead progress (the live wrapper directly — the
+                # property would construct a fresh one after a swap)
+                self._train_feed.stop()
 
         flush(time.time())  # records past the last log boundary
         if profiling:

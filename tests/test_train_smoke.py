@@ -3,6 +3,7 @@ integration-test role the reference delegated to a live EC2 cluster +
 evaluator process (SURVEY §4)."""
 
 import numpy as np
+import pytest
 
 from conftest import base_config
 
@@ -30,6 +31,7 @@ def test_sync_training_reduces_loss(tmp_train_dir, synthetic_datasets):
     assert summary["last_metrics"]["loss"] < first["loss"]
 
 
+@pytest.mark.slow  # trains past the smoke budget (the >=99% oracle); ~50 s
 def test_convergence_oracle(tmp_train_dir, synthetic_datasets):
     """Reaches ≥99% test accuracy — mirroring the reference's evaluator
     oracle (src/nn_eval.py:95-103) as an automated assertion."""
@@ -77,6 +79,7 @@ def test_fresh_run_truncates_train_log(tmp_train_dir, synthetic_datasets):
     assert steps == sorted(steps) and steps[-1] == 6
 
 
+@pytest.mark.slow  # jax.profiler trace windows are ~2 min on CPU
 def test_trace_every_steps_dumps_per_window(tmp_train_dir,
                                             synthetic_datasets):
     """train.trace_every_steps writes one profiler trace per cadence
