@@ -255,6 +255,22 @@ class TrainConfig:
     # mode ≙ --timeline_logging's per-iteration Chrome traces
     # (src/distributed_train.py:354-358). 0 disables.
     trace_every_steps: int = 0
+    # -- self-healing guards (train/loop.py) --------------------------
+    # NaN/Inf loss guard: a nonfinite loss at a flush point rolls the
+    # run back to the newest checkpoint whose params are finite instead
+    # of letting the poison propagate into every later step and
+    # checkpoint. Bounded: after nan_guard_max_rollbacks the run fails
+    # loudly (a deterministic divergence would otherwise loop forever —
+    # the guard exists for transient corruption, not bad hyperparams).
+    nan_guard: bool = True
+    nan_guard_max_rollbacks: int = 2
+    # Preemption handling: SIGTERM/SIGINT flush the AsyncCheckpointer
+    # and stop the loop cleanly; the CLI then exits with
+    # resumable_exit_code (default 75 = EX_TEMPFAIL) so a supervisor
+    # can tell "resume me" from a crash. Handlers are only installed
+    # when run() executes on the main thread.
+    handle_preemption: bool = True
+    resumable_exit_code: int = 75
 
 
 @dataclass(frozen=True)

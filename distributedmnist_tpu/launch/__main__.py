@@ -46,6 +46,14 @@ def _train(args) -> None:
 
     trainer = Trainer(cfg)
     summary = trainer.run()
+    if summary.get("preempted"):
+        # a flushed, resumable stop (SIGTERM/SIGINT mid-run): exit with
+        # the distinct resumable code so a supervisor restarts us
+        # instead of treating this as a crash; skip eval — the process
+        # was asked to leave
+        print(json.dumps({"summary": {k: v for k, v in summary.items()
+                                      if k != "timing"}}, default=str))
+        sys.exit(cfg.train.resumable_exit_code)
     result = trainer.evaluate("test")
     print(json.dumps({"summary": {k: v for k, v in summary.items() if k != "timing"},
                       "test": result}, default=str))

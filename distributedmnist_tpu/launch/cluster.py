@@ -96,6 +96,13 @@ class ClusterBackend(abc.ABC):
     @abc.abstractmethod
     def poll(self) -> dict[str, Any] | None: ...
 
+    # recovery verb (non-abstract so pre-existing backends stay valid):
+    # respawn ONE worker's training process in place; the worker's own
+    # resume-from-checkpoint logic decides where it continues
+    def restart_worker(self, k: int) -> None:
+        raise NotImplementedError(
+            f"{type(self).__name__} cannot restart individual workers")
+
 
 # ---------------------------------------------------------------------------
 # generic lifecycle drivers (backend-agnostic)
@@ -214,20 +221,31 @@ class GcloudTpuBackend(ClusterBackend):
 
     # -- work -----------------------------------------------------------
 
+    def _launch_command(self) -> str:
+        """The one nohup launch line — shared by the initial fan-out and
+        per-worker restarts so the two can never drift."""
+        outdir = shlex.quote(self.cfg.remote_outdir)
+        log = shlex.quote(f"{self.cfg.remote_outdir}/train_stdout.log")
+        return (f"mkdir -p {outdir} && cd ~ && "
+                f"nohup {self.cfg.train_command} > {log} 2>&1 &")
+
     def run_train(self) -> None:
         """≙ run_tf (tf_ec2.py:445): same command on every worker —
         jax.distributed discovers the slice topology; no role/host
         templating exists."""
-        outdir = shlex.quote(self.cfg.remote_outdir)
-        log = shlex.quote(f"{self.cfg.remote_outdir}/train_stdout.log")
-        self.runner.run(self._ssh(
-            f"mkdir -p {outdir} && cd ~ && "
-            f"nohup {self.cfg.train_command} > {log} 2>&1 &"), verb="run")
+        self.runner.run(self._ssh(self._launch_command()), verb="run")
 
     def kill_all(self, worker: str = "all") -> None:
         """≙ kill_all_python / kill_python (tf_ec2.py:617-649)."""
         self.runner.run(self._ssh("pkill -9 -f python || true", worker=worker),
                         check=False, verb="kill")
+
+    def restart_worker(self, k: int) -> None:
+        """Kill + relaunch the train command on ONE worker host (the
+        supervisor's recovery verb over SSH)."""
+        self.kill_all(worker=str(k))
+        self.runner.run(self._ssh(self._launch_command(), worker=str(k)),
+                        verb="run")
 
     def exec_all(self, command: str, worker: str = "all") -> None:
         """≙ run_command (tf_ec2.py:841)."""
@@ -321,7 +339,7 @@ class LocalProcessCluster(ClusterBackend):
         self.exec = executor or CommandExecutor(
             journal=self.cfg.root / "command_journal.jsonl",
             retry=RetryPolicy(max_attempts=1))
-        self._fault_killed: set[int] = set()
+        self._fault_fired: set[tuple[str, int]] = set()
 
     # -- state file -----------------------------------------------------
 
@@ -339,7 +357,21 @@ class LocalProcessCluster(ClusterBackend):
                                 for k in range(self.cfg.num_workers)]}
         if not self.state_path.exists():
             return {"phase": "absent", "workers": []}
-        return json.loads(self.state_path.read_text())
+        try:
+            state = json.loads(self.state_path.read_text())
+        except (json.JSONDecodeError, OSError) as e:
+            # a state file garbled by a killed previous run must not
+            # wedge every verb behind manual cleanup — treat it as
+            # absent (create() rebuilds it) and leave the evidence in
+            # the journal
+            logger.warning("state file %s unreadable (%s) — treating the "
+                           "cluster as absent", self.state_path, e)
+            self.exec.journal({"event": "lifecycle", "action": "stale_state",
+                               "cluster": self.cfg.name, "error": str(e)})
+            return {"phase": "absent", "workers": []}
+        if not isinstance(state.get("workers"), list):
+            state["workers"] = []
+        return state
 
     def _write_state(self, state: dict[str, Any]) -> None:
         if self.exec.dry_run:
@@ -396,36 +428,78 @@ class LocalProcessCluster(ClusterBackend):
                     "DMT_WORKER_DIR": str(self.cfg.worker_dir(k))})
         return env
 
+    def _pid_alive(self, pid: int) -> bool:
+        probe = self.exec.run(["sh", "-c", f"kill -0 {pid} 2>/dev/null"],
+                              verb="status", check=False, max_attempts=1)
+        return probe is not None and probe.returncode == 0
+
+    def _spawn_worker(self, w: dict[str, Any]) -> None:
+        """Spawn ONE worker process and record its pid in ``w`` (shared
+        by the initial ``run_train`` fan-out and per-worker restarts)."""
+        k = w["worker"]
+        logdir = Path(w["logdir"])
+        logdir.mkdir(parents=True, exist_ok=True)
+        log_fh = open(logdir / "train_stdout.log", "ab")
+        try:
+            proc = subprocess.Popen(
+                ["sh", "-c", self.cfg.train_command],
+                cwd=logdir, env=self._worker_env(k),
+                stdout=log_fh, stderr=subprocess.STDOUT,
+                start_new_session=True)
+        finally:
+            log_fh.close()  # the child holds its own descriptor
+        w["pid"] = proc.pid
+        self.exec.journal({"event": "spawn", "worker": k, "pid": proc.pid,
+                           "command": self.cfg.train_command})
+
     def run_train(self) -> None:
         """Spawn one REAL detached process per worker (≙ run_tf's
         nohup-per-host, tf_ec2.py:445) — stdout/stderr to the worker's
-        ``train_stdout.log``, pid recorded in the cluster state."""
+        ``train_stdout.log``, pid recorded in the cluster state.
+
+        Pids left in the state file by a previous killed driver are
+        reaped first: a re-run over a stale ``state.json`` must neither
+        double-spawn against still-live old workers nor require manual
+        cleanup. (The reap is a best-effort ``kill -9``; a pid recycled
+        by the OS since that run is the accepted local-tool risk.)"""
         state = self._read_state()
         if not state["workers"]:
             raise ClusterError("run_train before create: no workers")
         delay_s = self.exec.fault_plan.command_delay_s("run")
         for w in state["workers"]:
-            k = w["worker"]
-            logdir = Path(w["logdir"])
             if self.exec.dry_run:  # record the spawn argv, don't Popen
                 self.exec.run(["sh", "-c", self.cfg.train_command],
                               verb="run")
                 continue
+            if w.get("pid"):
+                if self._pid_alive(w["pid"]):
+                    self.exec.journal(
+                        {"event": "lifecycle", "action": "stale_worker_reaped",
+                         "worker": w["worker"], "pid": w["pid"]})
+                self._kill_pid(w["pid"], "kill")
+                w["pid"] = None
             if delay_s > 0:
                 time.sleep(delay_s)
-            log_fh = open(logdir / "train_stdout.log", "ab")
-            try:
-                proc = subprocess.Popen(
-                    ["sh", "-c", self.cfg.train_command],
-                    cwd=logdir, env=self._worker_env(k),
-                    stdout=log_fh, stderr=subprocess.STDOUT,
-                    start_new_session=True)
-            finally:
-                log_fh.close()  # the child holds its own descriptor
-            w["pid"] = proc.pid
-            self.exec.journal({"event": "spawn", "worker": k,
-                               "pid": proc.pid,
-                               "command": self.cfg.train_command})
+            self._spawn_worker(w)
+        state["phase"] = "running"
+        self._write_state(state)
+
+    def restart_worker(self, k: int) -> None:
+        """Respawn ONE worker in place (the supervisor's recovery verb):
+        best-effort kill of any previous pid, then a fresh spawn of the
+        same train command in the same logdir — the worker's own
+        resume-from-checkpoint logic decides where it continues."""
+        state = self._read_state()
+        sel = self._select(state["workers"], str(k))
+        if not sel:
+            raise ClusterError(f"restart_worker({k}): no such worker")
+        w = sel[0]
+        if self.exec.dry_run:
+            self.exec.run(["sh", "-c", self.cfg.train_command], verb="run")
+            return
+        if w.get("pid"):
+            self._kill_pid(w["pid"], "kill")
+        self._spawn_worker(w)
         state["phase"] = "running"
         self._write_state(state)
 
@@ -435,7 +509,14 @@ class LocalProcessCluster(ClusterBackend):
         return [w for w in workers if w["worker"] == int(worker)]
 
     def _kill_pid(self, pid: int, verb: str) -> None:
-        self.exec.run(["sh", "-c", f"kill -9 {pid} 2>/dev/null || true"],
+        # the recorded pid is a session/process-group leader
+        # (start_new_session=True) and `sh -c` FORKS the payload rather
+        # than exec it — killing only the shell would orphan the real
+        # worker, which then survives to keep training and writing.
+        # Signal the whole group (negative pid), falling back to the
+        # bare pid for processes that predate the group convention.
+        self.exec.run(["sh", "-c", f"kill -9 -{pid} 2>/dev/null || "
+                                   f"kill -9 {pid} 2>/dev/null || true"],
                       verb=verb, check=False)
 
     def kill_all(self, worker: str = "all") -> None:
@@ -455,14 +536,9 @@ class LocalProcessCluster(ClusterBackend):
         state = self._read_state()
         workers = []
         for w in state["workers"]:
-            alive = False
-            if w.get("pid"):
-                probe = self.exec.run(
-                    ["sh", "-c", f"kill -0 {w['pid']} 2>/dev/null"],
-                    verb="status", check=False, max_attempts=1)
-                # max_attempts=1: a dead pid is not transient — a
-                # retrying executor must not burn its budget observing it
-                alive = probe is not None and probe.returncode == 0
+            # max_attempts=1 in the probe: a dead pid is not transient —
+            # a retrying executor must not burn its budget observing it
+            alive = bool(w.get("pid")) and self._pid_alive(w["pid"])
             workers.append({"worker": w["worker"], "pid": w.get("pid"),
                             "alive": alive, "logdir": w["logdir"]})
         return {"state": state["phase"].upper(),
@@ -487,10 +563,120 @@ class LocalProcessCluster(ClusterBackend):
             self.exec.run(["cp", "-r", str(src), str(local_dir)],
                           verb="download")
 
+    def worker_progress(self) -> dict[int, int]:
+        """Per-worker latest logged step ({worker: step}; -1 when a
+        worker hasn't logged yet) — one real ``tail`` per worker. This
+        is the stall-detection signal: a SIGSTOPped or wedged worker
+        stays ``alive`` under the pid probe while its log stops moving,
+        so liveness alone cannot see a hang."""
+        state = self._read_state()
+        out: dict[int, int] = {}
+        for w in state["workers"]:
+            log = Path(w["logdir"]) / "train_log.jsonl"
+            res = self.exec.run(
+                ["sh", "-c", f"tail -n 1 {shlex.quote(str(log))} "
+                             f"2>/dev/null || true"],
+                verb="progress", check=False, max_attempts=1)
+            if res is None:  # dry-run
+                continue
+            out[w["worker"]] = parse_poll_output(res.stdout)["step"]
+        return out
+
+    def _latest_checkpoint_artifact(self, logdir: Path) -> Path | None:
+        """The file a torn-write fault should hit: the pointer's
+        latest_path when readable, else the newest ``ckpt-*`` data
+        file."""
+        try:
+            d = json.loads((logdir / "checkpoint.json").read_text())
+            target = logdir / d["latest_path"]
+            if target.exists():
+                return target
+        except (OSError, json.JSONDecodeError, KeyError, TypeError):
+            pass
+        cands = [p for p in logdir.glob("ckpt-*")
+                 if not p.name.endswith((".tmp", ".sha256"))]
+        return max(cands, key=lambda p: p.name) if cands else None
+
+    def _apply_poll_faults(self, state: dict[str, Any]
+                           ) -> dict[int, int] | None:
+        """Fire the step-triggered fault actions (each at most once per
+        worker): kill → hang → corrupt-latest-checkpoint. Returns the
+        worker-progress sweep it ran (None when no trigger was left to
+        fire) so poll() can share it instead of re-spawning N tails.
+
+        Worker-keyed triggers fire on the TARGET worker's own logged
+        step, not worker 0's: worker boots skew by tens of seconds (a
+        second jax process on a contended host), so "kill worker k at
+        step s" keyed to another worker's log could fire while k is
+        still booting — before it has done the work (e.g. saved the
+        checkpoint a corrupt action wants to tear) the scenario is
+        about."""
+        plan = self.exec.fault_plan
+        unfired = [(kind, mapping)
+                   for kind, mapping in
+                   (("kill", plan.kill_worker_at_step),
+                    ("hang", plan.hang_worker_at_step),
+                    ("corrupt", plan.corrupt_latest_checkpoint_at_step))
+                   if any((kind, k) not in self._fault_fired
+                          for k in mapping)]
+        if not unfired:
+            return None  # every trigger already fired — no tails
+        prog = self.worker_progress()
+        for k, s in plan.kill_worker_at_step.items():
+            if prog.get(k, -1) >= s and ("kill", k) not in self._fault_fired:
+                self._fault_fired.add(("kill", k))
+                for w in self._select(state["workers"], str(k)):
+                    if w.get("pid"):
+                        self._kill_pid(w["pid"], "fault")
+                        self.exec.journal(
+                            {"event": "fault", "action": "kill_worker",
+                             "worker": k, "pid": w["pid"],
+                             "at_step": prog[k], "planned_step": s})
+        for k, s in plan.hang_worker_at_step.items():
+            if prog.get(k, -1) >= s and ("hang", k) not in self._fault_fired:
+                self._fault_fired.add(("hang", k))
+                for w in self._select(state["workers"], str(k)):
+                    if w.get("pid"):
+                        # stop the whole group: the payload is the
+                        # shell's CHILD (see _kill_pid)
+                        self.exec.run(
+                            ["sh", "-c", f"kill -STOP -{w['pid']} "
+                                         f"2>/dev/null || "
+                                         f"kill -STOP {w['pid']} "
+                                         f"2>/dev/null || true"],
+                            verb="fault", check=False)
+                        self.exec.journal(
+                            {"event": "fault", "action": "hang_worker",
+                             "worker": k, "pid": w["pid"],
+                             "at_step": prog[k], "planned_step": s})
+        for k, s in plan.corrupt_latest_checkpoint_at_step.items():
+            if (prog.get(k, -1) >= s
+                    and ("corrupt", k) not in self._fault_fired):
+                self._fault_fired.add(("corrupt", k))
+                for w in self._select(state["workers"], str(k)):
+                    target = self._latest_checkpoint_artifact(
+                        Path(w["logdir"]))
+                    if target is None:
+                        self.exec.journal(
+                            {"event": "fault",
+                             "action": "corrupt_latest_checkpoint",
+                             "worker": k, "target": None,
+                             "at_step": prog[k], "planned_step": s})
+                        continue
+                    keep = max(1, target.stat().st_size // 2)
+                    self.exec.run(["truncate", "-s", str(keep),
+                                   str(target)], verb="fault", check=False)
+                    self.exec.journal(
+                        {"event": "fault",
+                         "action": "corrupt_latest_checkpoint",
+                         "worker": k, "target": target.name,
+                         "truncated_to": keep,
+                         "at_step": prog[k], "planned_step": s})
+
     def poll(self) -> dict[str, Any] | None:
         """Tail worker 0's ``train_log.jsonl`` via a real subprocess;
-        additionally the seam where the fault plan's mid-run worker
-        kill fires (the poll cadence is when the driver looks at the
+        additionally the seam where the fault plan's step-triggered
+        actions fire (the poll cadence is when the driver looks at the
         cluster — exactly when a lost worker becomes observable)."""
         state = self._read_state()
         if not state["workers"]:
@@ -504,18 +690,17 @@ class LocalProcessCluster(ClusterBackend):
             return None
         got = parse_poll_output(out.stdout)
         if state["phase"] == "running":
-            got["workers_alive"] = sum(
-                w["alive"] for w in self.status()["workers"])
-        for k, s in self.exec.fault_plan.kill_worker_at_step.items():
-            if got["step"] >= s and k not in self._fault_killed:
-                self._fault_killed.add(k)
-                for w in self._select(state["workers"], str(k)):
-                    if w.get("pid"):
-                        self._kill_pid(w["pid"], "fault")
-                        self.exec.journal(
-                            {"event": "fault", "action": "kill_worker",
-                             "worker": k, "pid": w["pid"],
-                             "at_step": got["step"], "planned_step": s})
+            st = self.status()
+            got["workers_alive"] = sum(w["alive"] for w in st["workers"])
+            # the full per-worker snapshot rides along so a supervisor
+            # polling every tick doesn't re-run N liveness probes it
+            # already paid for here
+            got["workers"] = st["workers"]
+        prog = self._apply_poll_faults(state)
+        if prog is not None:
+            # share the fault hook's progress sweep with callers (the
+            # supervisor) instead of letting them re-spawn N tails
+            got["worker_progress"] = prog
         return got
 
 
@@ -544,7 +729,7 @@ def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(prog="distributedmnist_tpu.launch cluster")
     p.add_argument("action",
                    choices=["create", "delete", "status", "run", "kill-all",
-                            "exec", "download", "poll"])
+                            "exec", "download", "poll", "supervise"])
     p.add_argument("--backend", default="local", choices=["local", "gcloud"])
     p.add_argument("--config", default=None,
                    help="LocalClusterConfig / PodConfig JSON")
@@ -564,10 +749,23 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--max-attempts", type=int, default=1,
                    help="retry budget for transient command failures")
     p.add_argument("--until-step", type=int, default=None, metavar="N",
-                   help="for run/poll: follow train_log.jsonl and return "
-                        "at step N (run also stops the cluster)")
+                   help="for run/poll/supervise: follow train_log.jsonl and "
+                        "return at step N (run/supervise also stop the "
+                        "cluster)")
     p.add_argument("--poll-secs", type=float, default=5.0)
     p.add_argument("--poll-timeout-s", type=float, default=24 * 3600.0)
+    p.add_argument("--supervisor-config", default=None,
+                   help="for supervise: SupervisorConfig JSON (quorum, "
+                        "restart budget/backoff, stall timeout); flags "
+                        "below override it")
+    p.add_argument("--quorum", type=int, default=None,
+                   help="for supervise: min live workers to continue")
+    p.add_argument("--max-restarts", type=int, default=None,
+                   help="for supervise: restart budget per worker")
+    p.add_argument("--restart-backoff-s", type=float, default=None,
+                   help="for supervise: base restart backoff")
+    p.add_argument("--stall-timeout-s", type=float, default=None,
+                   help="for supervise: hang detection window (0 = off)")
     args = p.parse_args(argv)
 
     fault = FaultPlan.from_file(args.fault_plan) if args.fault_plan else None
@@ -596,6 +794,22 @@ def main(argv: list[str] | None = None) -> None:
                 timeout_secs=args.poll_timeout_s)))
         else:
             backend.run_train()
+    elif args.action == "supervise":
+        from .supervisor import ClusterSupervisor, SupervisorConfig
+        if args.until_step is None:
+            p.error("supervise requires --until-step")
+        scfg = (SupervisorConfig.from_file(args.supervisor_config)
+                if args.supervisor_config else SupervisorConfig())
+        overrides = {"quorum": args.quorum,
+                     "max_restarts_per_worker": args.max_restarts,
+                     "restart_backoff_s": args.restart_backoff_s,
+                     "stall_timeout_s": args.stall_timeout_s}
+        scfg = dataclasses.replace(
+            scfg, **{k: v for k, v in overrides.items() if v is not None})
+        sup = ClusterSupervisor(backend, scfg)
+        print(json.dumps(sup.run_until_step(
+            args.until_step, poll_secs=args.poll_secs,
+            timeout_secs=args.poll_timeout_s)))
     elif args.action == "poll":
         if args.until_step is not None:
             print(json.dumps(wait_until_step(

@@ -95,14 +95,41 @@ class FaultPlan:
                                          of ``verb`` (straggler class)
     ``kill_worker_at_step`` {k: s}     — LocalProcessCluster kills
                                          worker ``k`` once a poll
-                                         observes step >= ``s``
-                                         (mid-run worker loss)
+                                         observes ITS OWN log at step
+                                         >= ``s`` (mid-run worker loss;
+                                         per-worker logs skew by whole
+                                         boot times, so triggers key on
+                                         the target worker)
+    ``hang_worker_at_step`` {k: s}     — SIGSTOP worker ``k`` once its
+                                         log reaches step >= ``s``: the
+                                         pid stays alive but the run
+                                         stalls (the hung-worker half
+                                         of the failure regime —
+                                         liveness probes alone cannot
+                                         see it)
+    ``corrupt_latest_checkpoint_at_step`` {k: s} — once worker ``k``'s
+                                         log reaches step >= ``s``,
+                                         truncate the latest checkpoint
+                                         artifact in its logdir (a torn
+                                         write at the worst moment: a
+                                         restarted worker must fall
+                                         back to the previous loadable
+                                         step)
+
+    Every action fires at most once per worker per run.
     """
 
     fail_first: dict[str, int] = dataclasses.field(default_factory=dict)
     delay_ms: dict[str, float] = dataclasses.field(default_factory=dict)
     kill_worker_at_step: dict[int, int] = dataclasses.field(
         default_factory=dict)
+    hang_worker_at_step: dict[int, int] = dataclasses.field(
+        default_factory=dict)
+    corrupt_latest_checkpoint_at_step: dict[int, int] = dataclasses.field(
+        default_factory=dict)
+
+    _WORKER_KEYED = ("kill_worker_at_step", "hang_worker_at_step",
+                     "corrupt_latest_checkpoint_at_step")
 
     @classmethod
     def from_file(cls, path: str | Path) -> "FaultPlan":
@@ -111,10 +138,9 @@ class FaultPlan:
         if unknown:
             raise ExecError(f"unknown fault plan keys: {sorted(unknown)}")
         # JSON object keys are strings; worker indices are ints
-        if "kill_worker_at_step" in d:
-            d["kill_worker_at_step"] = {int(k): int(v)
-                                        for k, v in
-                                        d["kill_worker_at_step"].items()}
+        for key in cls._WORKER_KEYED:
+            if key in d:
+                d[key] = {int(k): int(v) for k, v in d[key].items()}
         return cls(**d)
 
     def should_fail(self, verb: str, attempt: int) -> bool:

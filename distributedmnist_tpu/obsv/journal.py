@@ -21,6 +21,50 @@ def load_journal(path: str | Path) -> list[dict]:
     return load_jsonl(path, event="command")
 
 
+def load_recovery_events(path: str | Path) -> list[dict]:
+    """Structured recovery records (``event: "recovery"``) — written by
+    the supervisor into the command journal and by the trainer /
+    checkpoint layer into ``train_dir/recovery_journal.jsonl``."""
+    return load_jsonl(path, event="recovery")
+
+
+def summarize_recovery_events(records: list[dict]) -> dict[str, Any]:
+    """Aggregate recovery records into the episode's evidence:
+
+    * ``by_action`` — counts per action (detect, restart, resume,
+      nan_rollback, corrupt_checkpoint_fallback, …),
+    * ``by_worker`` — each worker's ordered action chain, e.g.
+      ``["detect", "restart", "resume"]`` for a clean
+      kill → restart → resume episode,
+    * ``quorum_transitions`` — the workers_alive trajectory,
+    * ``resume_steps`` — {worker: step} where restarted workers picked
+      the run back up.
+    """
+    by_action: dict[str, int] = {}
+    by_worker: dict[int, list[str]] = {}
+    quorum: list[dict] = []
+    resume_steps: dict[int, int] = {}
+    for rec in records:
+        action = rec.get("action", "?")
+        by_action[action] = by_action.get(action, 0) + 1
+        if "worker" in rec:
+            by_worker.setdefault(rec["worker"], []).append(action)
+        if action == "quorum_transition":
+            quorum.append({k: rec.get(k) for k in
+                           ("workers_alive", "num_workers", "quorum",
+                            "degraded")})
+        if action == "resume" and "worker" in rec:
+            resume_steps[rec["worker"]] = rec.get("step")
+    return {"events": len(records), "by_action": by_action,
+            "by_worker": by_worker, "quorum_transitions": quorum,
+            "resume_steps": resume_steps}
+
+
+def summarize_recovery(path: str | Path) -> dict[str, Any]:
+    """Load + aggregate the recovery events in one journal file."""
+    return summarize_recovery_events(load_recovery_events(path))
+
+
 def summarize_journal(path: str | Path) -> dict[str, Any]:
     """Aggregate a command journal into run-level evidence.
 

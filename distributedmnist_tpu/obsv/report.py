@@ -64,6 +64,11 @@ def load_experiment(train_dir: str | Path,
         "evals": [],
         "step_times": None,
         "time_acc": None,
+        # trainer-side self-healing events (NaN rollbacks, corrupt-
+        # checkpoint fallbacks, preemption flushes) — empty for a run
+        # that never needed to recover
+        "recovery": load_jsonl(train_dir / "recovery_journal.jsonl",
+                               "recovery"),
     }
     if eval_dir is not None:
         data["evals"] = load_jsonl(Path(eval_dir) / "eval_log.jsonl", "eval")
@@ -97,6 +102,9 @@ def experiment_stats(data: dict[str, Any]) -> dict[str, Any]:
         best = max(e["precision_at_1"] for e in data["evals"])
         out["best_precision_at_1"] = best
         out["final_precision_at_1"] = data["evals"][-1]["precision_at_1"]
+    if data.get("recovery"):
+        from .journal import summarize_recovery_events
+        out["recovery"] = summarize_recovery_events(data["recovery"])
     m = data["step_times"]
     if m is not None and m.size:
         out["per_replica"] = [compute_stats(m[:, i]).to_dict()

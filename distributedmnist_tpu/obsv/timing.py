@@ -137,12 +137,26 @@ class ReplicaDeviceProbe:
     """Per-replica DEVICE-side completion probes.
 
     One representative device per LOCAL replica is probed each step
-    with a trivial jitted op on a device-resident token: per-device
-    execution is FIFO, so the probe completes only once everything
-    queued on that device — the train step's program slice plus any
-    work dispatched after it (injected chaos programs, per-device
-    callbacks) — has drained. Readiness is POLLED (not serially
-    blocked) so each device gets its own completion timestamp.
+    with a trivial jitted op on a device-resident token. On real
+    accelerator backends per-device execution is FIFO, so the probe
+    completes only once everything queued on that device — the train
+    step's program slice plus any work dispatched after it (injected
+    chaos programs, per-device callbacks) — has drained. Readiness is
+    POLLED (not serially blocked) so each device gets its own
+    completion timestamp.
+
+    FIFO does NOT hold everywhere: the CPU client executes
+    data-independent same-device computations on a shared host pool, so
+    a bare token probe there either completes while injected work is
+    still in flight (reads zero skew) or queues behind it on EVERY
+    device at once (the shared pool stalls all probes together and the
+    min-subtraction erases the differential). For work the dispatcher
+    has a handle on, :meth:`note` registers the dispatched output with
+    its replica and a dispatch timestamp; the drain measurement times
+    each noted output from its OWN dispatch — a per-device load signal
+    no shared-pool stall can smear across devices — and takes the max
+    of that and the token-probe skew, so FIFO backends (where the token
+    probe already queues behind the noted work) do not double-count.
 
     The lockstep SPMD step itself cannot produce skew (its collectives
     barrier the devices); what this measures is precisely the
@@ -165,21 +179,69 @@ class ReplicaDeviceProbe:
         self._tokens = [jax.device_put(np.float32(0), d)
                         for _, d in self.devices]
         self._inc = jax.jit(lambda x: x + 1.0)
+        # warm the per-device executables NOW: the first call per token
+        # sharding compiles, and a compile inside measure_skew_ms would
+        # charge ~tens of ms of compiler time to whichever device the
+        # loop reached first
+        for t in self._tokens:
+            self._inc(t).block_until_ready()
+        self._index_of = {r: i for i, (r, _) in enumerate(self.devices)}
+        self._noted: list[list] = [[] for _ in self.devices]
+
+    def note(self, replica: int, out) -> None:
+        """Register a just-dispatched computation's output as part of
+        ``replica``'s device queue for the NEXT ``measure_skew_ms``
+        (the chaos-injection seam; no-op for non-local replicas)."""
+        i = self._index_of.get(replica)
+        if i is not None:
+            self._noted[i].append((out, time.perf_counter()))
 
     def measure_skew_ms(self) -> np.ndarray:
-        """Dispatch one probe per local replica device and poll their
-        completions; returns per-local-replica drain skew in ms
-        (min-subtracted, so a lockstep step reads ~zero)."""
+        """Dispatch one probe per local replica device and poll
+        completions; returns per-local-replica drain skew in ms.
+
+        Per device: the token probe's completion time (min-subtracted
+        across devices — the differential a lockstep step reads as
+        ~zero) maxed with each noted output's dispatch-to-ready
+        duration (zero when nothing was noted).
+
+        The noted duration is an UPPER bound on the replica's excess:
+        on FIFO backends it also includes whatever residual step drain
+        was queued ahead at dispatch (the token differential alone
+        reports the exact excess there, and the max keeps it when it is
+        larger… the noted value can only overstate the magnitude, never
+        the ORDERING — the noted replica genuinely drains last, which
+        is what quorum selection ranks on). Separating the shared-drain
+        component out is not robustly measurable across queue
+        disciplines: subtracting the token baseline erases the signal
+        on shared-pool backends, where that baseline is itself the
+        noted program's doing."""
         import jax  # noqa: F401  (tokens/jit already bound)
         outs = [self._inc(t) for t in self._tokens]
+        noted, self._noted = self._noted, [[] for _ in self.devices]
         t0 = time.perf_counter()
         times = np.zeros(len(outs), np.float64)
+        extra = np.zeros(len(outs), np.float64)
         pending = set(range(len(outs)))
-        while pending:
+        npending = {i for i in range(len(outs)) if noted[i]}
+        while pending or npending:
+            now = time.perf_counter()
             for i in list(pending):
                 if outs[i].is_ready():
-                    times[i] = (time.perf_counter() - t0) * 1000.0
+                    times[i] = (now - t0) * 1000.0
                     pending.discard(i)
-            if pending:
+            for i in list(npending):
+                # drop entries as they finish; the device's extra is
+                # its slowest noted program's dispatch→ready duration
+                still = []
+                for a, at in noted[i]:
+                    if a.is_ready():
+                        extra[i] = max(extra[i], (now - at) * 1000.0)
+                    else:
+                        still.append((a, at))
+                noted[i] = still
+                if not still:
+                    npending.discard(i)
+            if pending or npending:
                 time.sleep(0.0002)
-        return (times - times.min()).astype(np.float32)
+        return np.maximum(times - times.min(), extra).astype(np.float32)

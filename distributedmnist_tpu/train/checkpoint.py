@@ -28,13 +28,14 @@ and the evaluator's read side (src/nn_eval.py:70-88). Differences:
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
 import threading
 import time
 from pathlib import Path
-from typing import Any
+from typing import Any, Callable
 
 import jax
 import numpy as np
@@ -45,6 +46,48 @@ from ..core.log import get_logger
 logger = get_logger("checkpoint")
 
 _POINTER = "checkpoint.json"
+_DIGEST_SUFFIX = ".sha256"
+
+
+class CheckpointCorruptError(ValueError):
+    """A checkpoint artifact exists but cannot be trusted: torn write
+    (truncated msgpack / unparseable manifest) or checksum mismatch.
+    Distinct from FileNotFoundError (an incomplete publish) so callers
+    can tell "never finished writing" from "finished then damaged" —
+    both fall back to the previous loadable step on restore.
+
+    Subclasses ValueError because that is what the raw failures
+    (msgpack unpack errors, json.JSONDecodeError) raised before this
+    wrapper existed — long-running consumers like the eval service
+    catch ValueError around checkpoint reads and skip-and-retry; this
+    type must keep flowing into those handlers, not crash them."""
+
+
+# -- I/O retry wrapper ------------------------------------------------------
+#
+# Checkpoint reads/writes hit network filesystems in production; a
+# transient EIO/ESTALE must not look like corruption (which would
+# discard a perfectly good step). FileNotFoundError stays immediate:
+# a missing file is a publish-ordering fact, not a flake.
+
+_IO_ATTEMPTS = 3
+_IO_BACKOFF_S = 0.05
+
+
+def _io_retries(fn: Callable[[], Any], what: str) -> Any:
+    for attempt in range(1, _IO_ATTEMPTS + 1):
+        try:
+            return fn()
+        except FileNotFoundError:
+            raise
+        except OSError as e:
+            if attempt == _IO_ATTEMPTS:
+                raise
+            delay = _IO_BACKOFF_S * 2 ** (attempt - 1)
+            logger.warning("I/O error on %s (%s) — attempt %d/%d, "
+                           "retrying in %.2fs", what, e, attempt,
+                           _IO_ATTEMPTS, delay)
+            time.sleep(delay)
 
 
 def _ckpt_path(train_dir: Path, step: int) -> Path:
@@ -121,10 +164,75 @@ def snapshot_for_save(state: Any):
     return ("sharded", local, meta)
 
 
-def _write_atomic(path: Path, data: bytes) -> None:
-    tmp = path.with_suffix(path.suffix + ".tmp")
-    tmp.write_bytes(data)
-    os.replace(tmp, path)
+def _digest_path(path: Path) -> Path:
+    return path.with_suffix(path.suffix + _DIGEST_SUFFIX)
+
+
+def _write_atomic(path: Path, data: bytes, digest: bool = True) -> None:
+    def write() -> None:
+        tmp = path.with_suffix(path.suffix + ".tmp")
+        tmp.write_bytes(data)
+        dpath = _digest_path(path)
+        if digest:
+            # drop any PREVIOUS sidecar before the data lands: this
+            # path can be overwritten (a NaN rollback or kill+resume
+            # re-saves the same step), and a crash after the new data
+            # but before the new digest must leave a digest-LESS
+            # (legacy-accepted) file — never old-digest-over-new-bytes,
+            # which would reject a perfectly good checkpoint
+            dpath.unlink(missing_ok=True)
+        os.replace(tmp, path)
+        if digest:
+            dtmp = dpath.with_name(dpath.name + ".tmp")
+            dtmp.write_text(hashlib.sha256(data).hexdigest())
+            os.replace(dtmp, dpath)
+    _io_retries(write, path.name)
+
+
+def _verified_read(path: Path) -> bytes:
+    """Read ``path`` (with I/O retries) and verify it against its
+    digest sidecar when one exists — a file without a sidecar is
+    accepted as-is (pre-checksum layout, or a crash between data and
+    digest writes)."""
+    data = _io_retries(path.read_bytes, path.name)
+    dpath = _digest_path(path)
+    if dpath.exists():
+        want = _io_retries(dpath.read_text, dpath.name).strip()
+        got = hashlib.sha256(data).hexdigest()
+        if want and got != want:
+            raise CheckpointCorruptError(
+                f"{path.name}: sha256 mismatch (file {got[:12]}… != "
+                f"recorded {want[:12]}…)")
+    return data
+
+
+def _msgpack_restore_checked(data: bytes, path: Path) -> Any:
+    try:
+        return serialization.msgpack_restore(data)
+    except Exception as e:  # msgpack raises several unpack error types
+        raise CheckpointCorruptError(
+            f"{path.name}: torn or corrupt msgpack ({type(e).__name__}: "
+            f"{e})") from e
+
+
+def _manifest_checksum(manifest: dict) -> str:
+    body = {k: v for k, v in manifest.items() if k != "checksum"}
+    return hashlib.sha256(
+        json.dumps(body, sort_keys=True).encode()).hexdigest()
+
+
+def _read_manifest(train_dir: Path, step: int) -> dict:
+    mpath = _manifest_path(train_dir, step)
+    text = _io_retries(mpath.read_text, mpath.name)
+    try:
+        manifest = json.loads(text)
+    except json.JSONDecodeError as e:
+        raise CheckpointCorruptError(
+            f"{mpath.name}: torn or corrupt manifest ({e})") from e
+    want = manifest.get("checksum")
+    if want and _manifest_checksum(manifest) != want:
+        raise CheckpointCorruptError(f"{mpath.name}: checksum mismatch")
+    return manifest
 
 
 def _write_pointer(train_dir: Path, step: int, latest_name: str) -> None:
@@ -167,8 +275,9 @@ def save_checkpoint(train_dir: str | Path, state: Any, step: int,
     if pidx == 0:
         manifest = {"step": step, "num_shards": pcount, "leaves": meta,
                     "extra": extra or {}}
+        manifest["checksum"] = _manifest_checksum(manifest)
         mpath = _manifest_path(train_dir, step)
-        _write_atomic(mpath, json.dumps(manifest).encode())
+        _write_atomic(mpath, json.dumps(manifest).encode(), digest=False)
         _write_pointer(train_dir, step, mpath.name)
         logger.info("saved sharded checkpoint step=%d → %s (+%d shard files)",
                     step, mpath.name, pcount)
@@ -341,6 +450,12 @@ def latest_checkpoint_step(train_dir: str | Path) -> int | None:
     return max(steps)
 
 
+def loadable_steps(train_dir: str | Path) -> list[int]:
+    """Public view of the restorable steps in ``train_dir`` (ascending)
+    — what the NaN-guard rollback and the supervisor iterate over."""
+    return _loadable_steps(Path(train_dir))
+
+
 def _loadable_steps(train_dir: Path) -> list[int]:
     """Steps that can actually be restored: a single-file .msgpack or a
     manifest (shard files alone — a crash mid-publish — don't count)."""
@@ -368,8 +483,9 @@ def read_checkpoint_extra(train_dir: str | Path,
             return None
     mpath = _manifest_path(train_dir, step)
     if mpath.exists():
-        return json.loads(mpath.read_text()).get("extra", {}), step
-    payload = serialization.msgpack_restore(_ckpt_path(train_dir, step).read_bytes())
+        return _read_manifest(train_dir, step).get("extra", {}), step
+    path = _ckpt_path(train_dir, step)
+    payload = _msgpack_restore_checked(_verified_read(path), path)
     extra = payload.get("extra", {})
     if isinstance(extra, (str, bytes)):
         extra = json.loads(extra)
@@ -381,22 +497,37 @@ def _restore_sharded(train_dir: Path, template_state: Any,
     """Reassemble full global arrays from every process's shard file
     (readable by ANY process count — the evaluator or a resumed
     cluster of a different size reads the same files)."""
-    manifest = json.loads(_manifest_path(train_dir, step).read_text())
-    pcount = int(manifest["num_shards"])
-    meta = manifest["leaves"]
+    manifest = _read_manifest(train_dir, step)
+    try:
+        pcount = int(manifest["num_shards"])
+        meta = manifest["leaves"]
+    except (KeyError, TypeError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"{_manifest_path(train_dir, step).name}: manifest missing "
+            f"required fields ({type(e).__name__}: {e})") from e
     leaves: dict[str, np.ndarray] = {}
     for p in range(pcount):
-        payload = serialization.msgpack_restore(
-            _shard_path(train_dir, step, p, pcount).read_bytes())
-        for key, val in payload["leaves"].items():
-            if isinstance(val, dict) and "indices" in val:
-                m = meta[key]
-                buf = leaves.setdefault(
-                    key, np.empty(tuple(m["shape"]), np.dtype(m["dtype"])))
-                for idx, data in zip(val["indices"], val["datas"]):
-                    buf[tuple(slice(a, b) for a, b in idx)] = data
-            elif key not in leaves:  # locally-complete leaf (first wins)
-                leaves[key] = np.asarray(val)
+        spath = _shard_path(train_dir, step, p, pcount)
+        payload = _msgpack_restore_checked(_verified_read(spath), spath)
+        try:
+            for key, val in payload["leaves"].items():
+                if isinstance(val, dict) and "indices" in val:
+                    m = meta[key]
+                    buf = leaves.setdefault(
+                        key,
+                        np.empty(tuple(m["shape"]), np.dtype(m["dtype"])))
+                    for idx, data in zip(val["indices"], val["datas"]):
+                        buf[tuple(slice(a, b) for a, b in idx)] = data
+                elif key not in leaves:  # locally-complete leaf (first wins)
+                    leaves[key] = np.asarray(val)
+        except (KeyError, ValueError, TypeError, IndexError) as e:
+            # structure that contradicts the manifest (missing meta,
+            # slab shapes that don't fit) is damage to THIS step —
+            # distinct from a template mismatch, which surfaces later
+            # in from_state_dict and must stay loud
+            raise CheckpointCorruptError(
+                f"{spath.name}: shard/manifest structure mismatch "
+                f"({type(e).__name__}: {e})") from e
     nested: dict = {}
     for key, arr in leaves.items():
         node = nested
@@ -407,8 +538,10 @@ def _restore_sharded(train_dir: Path, template_state: Any,
 
     # None fields (momentum off, non-interval mode) have no leaves, so
     # the flattened files carry no entry — graft them back from the
-    # template so from_state_dict sees every field (a missing non-None
-    # leaf stays a loud KeyError: that's real corruption)
+    # template so from_state_dict sees every field. A missing non-None
+    # leaf means the shard set doesn't actually hold this step's state:
+    # damage to THIS step, so it surfaces as CheckpointCorruptError and
+    # the restore falls back to an older one.
     def graft_nones(sub: Any, tmpl: Any) -> Any:
         if tmpl is None:
             return None
@@ -420,22 +553,45 @@ def _restore_sharded(train_dir: Path, template_state: Any,
                     for k, tv in tmpl.items()}
         return sub
 
-    nested = graft_nones(nested, serialization.to_state_dict(template_state))
+    try:
+        nested = graft_nones(nested,
+                             serialization.to_state_dict(template_state))
+    except KeyError as e:
+        raise CheckpointCorruptError(
+            f"sharded checkpoint step={step} is missing leaf {e} that "
+            "the state requires") from e
     state = serialization.from_state_dict(template_state, nested)
     return state, manifest.get("extra", {}), step
 
 
+# Exceptions that mean "THIS step is unusable, an older one may not
+# be": incomplete publish (FileNotFoundError), torn/garbled/lying
+# artifacts (CheckpointCorruptError — parse failures, checksum
+# mismatches, and shard/manifest structure contradictions are all
+# wrapped into it at the read sites), or I/O that stayed broken through
+# the retry budget (OSError). Deliberately NOT broader: a
+# template/model mismatch (from_state_dict errors) affects EVERY step
+# equally and must surface loudly, not silently discard the run by
+# "falling back" past all of it.
+_FALLBACK_ERRORS = (FileNotFoundError, CheckpointCorruptError, OSError)
+
+
 def restore_checkpoint(train_dir: str | Path, template_state: Any,
-                       step: int | None = None) -> tuple[Any, dict, int] | None:
+                       step: int | None = None,
+                       on_event: Callable[[dict], None] | None = None,
+                       ) -> tuple[Any, dict, int] | None:
     """Restore (state, extra, step); None when nothing exists
     (≙ Supervisor's restore-if-present, src/distributed_train.py:262).
     Handles both the single-file and the per-host sharded layouts.
 
-    When no explicit ``step`` is given, a torn latest checkpoint (a
-    sharded publish interrupted between process 0's manifest and a
-    sibling's shard file — there is no cross-process barrier in the
-    async writer) falls back to the next older complete step instead of
-    wedging the resume forever."""
+    When no explicit ``step`` is given, an unusable latest checkpoint —
+    a torn sharded publish (interrupted between process 0's manifest
+    and a sibling's shard file; there is no cross-process barrier in
+    the async writer), a truncated file, or a checksum mismatch — falls
+    back to the next older loadable step instead of wedging the resume
+    forever. Each skipped step is reported through ``on_event`` (a
+    recovery-journal hook; receives one dict per fallback and one for
+    the step finally restored when any fallback happened)."""
     train_dir = Path(train_dir)
     if step is not None:
         return _restore_step(train_dir, template_state, step)
@@ -443,12 +599,24 @@ def restore_checkpoint(train_dir: str | Path, template_state: Any,
     latest = latest_checkpoint_step(train_dir)
     if latest is not None and latest not in candidates:
         candidates.append(latest)
+    fell_back = False
     for s in sorted(set(candidates), reverse=True):
         try:
-            return _restore_step(train_dir, template_state, s)
-        except FileNotFoundError as e:
-            logger.warning("checkpoint step=%d is incomplete (%s); "
-                           "falling back to an older step", s, e)
+            got = _restore_step(train_dir, template_state, s)
+        except _FALLBACK_ERRORS as e:
+            fell_back = True
+            logger.warning("checkpoint step=%d is unusable (%s: %s); "
+                           "falling back to an older step",
+                           s, type(e).__name__, e)
+            if on_event is not None:
+                on_event({"layer": "checkpoint",
+                          "action": "corrupt_checkpoint_fallback",
+                          "bad_step": s, "error": f"{type(e).__name__}: {e}"})
+            continue
+        if fell_back and on_event is not None:
+            on_event({"layer": "checkpoint", "action": "fallback_restore",
+                      "step": got[2]})
+        return got
     return None
 
 
@@ -457,7 +625,10 @@ def _restore_step(train_dir: Path, template_state: Any,
     if _manifest_path(train_dir, step).exists():
         return _restore_sharded(train_dir, template_state, step)
     path = _ckpt_path(train_dir, step)
-    payload = serialization.msgpack_restore(path.read_bytes())
+    payload = _msgpack_restore_checked(_verified_read(path), path)
+    if not isinstance(payload, dict) or "state" not in payload:
+        raise CheckpointCorruptError(
+            f"{path.name}: payload has no 'state' entry")
     saved = payload["state"]
     # Migration: drop top-level fields the current TrainState no longer
     # has (e.g. pre-round-3 checkpoints carried a measured_ms scalar) —

@@ -11,6 +11,9 @@ batched at log points so the device pipeline stays async between them.
 
 from __future__ import annotations
 
+import math
+import signal
+import threading
 import time
 from pathlib import Path
 from typing import Any, Callable
@@ -33,6 +36,26 @@ from . import checkpoint as ckpt
 from .lr_schedule import constant, decay_steps_for, exponential_decay
 
 logger = get_logger("train")
+
+
+class _NonFiniteLoss(Exception):
+    """Raised inside the flush path when the NaN/Inf guard trips;
+    carries the step the poison was first observed at."""
+
+    def __init__(self, step: int, loss: float):
+        super().__init__(f"nonfinite loss {loss!r} at step {step}")
+        self.step = step
+        self.loss = loss
+
+
+def _params_finite(state) -> bool:
+    """True when every floating-point param leaf is finite — the
+    is-this-checkpoint-poisoned test the NaN-guard rollback applies."""
+    for leaf in jax.tree.leaves(state.params):
+        a = np.asarray(jax.device_get(leaf))
+        if np.issubdtype(a.dtype, np.floating) and not np.isfinite(a).all():
+            return False
+    return True
 
 
 class Trainer:
@@ -149,6 +172,11 @@ class Trainer:
                 "train.save_interval_steps (and save_interval_secs=0)")
         self._checkpointer: ckpt.AsyncCheckpointer | None = None
         self._sink: JsonlSink | None = None
+        # Structured recovery events (NaN rollbacks, corrupt-checkpoint
+        # fallbacks, preemption flushes) — the trainer-side half of the
+        # journal obsv.journal.summarize_recovery aggregates.
+        self._recovery_sink: JsonlSink | None = None
+        self._preempt_requested: str | None = None
         # TB scalars on the summary cadence (≙ chief summary writes,
         # src/distributed_train.py:382-390)
         self._tb = None
@@ -195,8 +223,20 @@ class Trainer:
                 depth=self.cfg.data.device_prefetch_depth)
         return self._train_feed
 
+    def _recovery_event(self, record: dict) -> None:
+        """Append one structured recovery event to
+        ``train_dir/recovery_journal.jsonl`` (writer process only)."""
+        if not self.is_writer:
+            return
+        if self._recovery_sink is None:
+            self._recovery_sink = JsonlSink(
+                self.train_dir / "recovery_journal.jsonl")
+        self._recovery_sink.write(
+            {"event": "recovery", "time": time.time(), **record})
+
     def _maybe_resume(self) -> None:
-        restored = ckpt.restore_checkpoint(self.train_dir, self.state)
+        restored = ckpt.restore_checkpoint(self.train_dir, self.state,
+                                           on_event=self._recovery_event)
         if restored is None:
             return
         state, extra, step = restored
@@ -259,6 +299,72 @@ class Trainer:
                                  extra=extra,
                                  keep=self.cfg.train.keep_checkpoints)
         self._last_save_time = time.time()
+
+    def _rollback_to_last_good(self, err: _NonFiniteLoss) -> int:
+        """NaN-guard rollback: restore the newest checkpoint whose
+        params are finite (a cadence save may already have captured the
+        poison) and return the loop step to continue from. The guard
+        exists for transient corruption — a flipped bit, a bad host —
+        not for genuinely divergent optimization, which will reproduce
+        the NaN and exhaust ``nan_guard_max_rollbacks``."""
+        for s in sorted(ckpt.loadable_steps(self.train_dir), reverse=True):
+            try:
+                state, extra, got = ckpt.restore_checkpoint(
+                    self.train_dir, self.state, step=s)
+            except Exception as e:
+                self._recovery_event({"layer": "train",
+                                      "action": "rollback_candidate_unusable",
+                                      "step": s, "error": str(e)})
+                continue
+            if not _params_finite(state):
+                self._recovery_event({"layer": "train",
+                                      "action": "rollback_candidate_poisoned",
+                                      "step": s})
+                continue
+            self.state = self.topo.device_put_state(state, self.state_specs)
+            if "data_iter" in extra:
+                try:
+                    self.train_feed.restore(extra["data_iter"])
+                except (AttributeError, KeyError, ValueError, RuntimeError):
+                    logger.warning("could not restore data-iterator state "
+                                   "on rollback; restarting stream")
+            loop_step = int(jax.device_get(self.state.step))
+            logger.warning("nonfinite loss at step %d — rolled back to "
+                           "checkpoint step=%d", err.step, loop_step)
+            self._recovery_event({"layer": "train", "action": "nan_rollback",
+                                  "from_step": err.step,
+                                  "to_step": loop_step,
+                                  "loss": repr(err.loss)})
+            return loop_step
+        raise RuntimeError(
+            f"nonfinite loss at step {err.step} and no finite checkpoint "
+            "to roll back to") from err
+
+    def _install_preempt_handlers(self) -> dict | None:
+        """SIGTERM/SIGINT → finish the current step, flush a
+        checkpoint, stop cleanly (the CLI exits with
+        train.resumable_exit_code). Main thread only — elsewhere the
+        signal API refuses, and the process owner is handling signals
+        itself."""
+        if not self.cfg.train.handle_preemption:
+            return None
+        if threading.current_thread() is not threading.main_thread():
+            return None
+
+        def handler(signum, frame):
+            self._preempt_requested = signal.Signals(signum).name
+            logger.warning("received %s — will flush a checkpoint and "
+                           "stop (resumable)", self._preempt_requested)
+
+        saved: dict = {}
+        try:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                saved[sig] = signal.signal(sig, handler)
+        except (ValueError, OSError):
+            for sig, old in saved.items():
+                signal.signal(sig, old)
+            return None
+        return saved
 
     def _sink_write(self, record: dict) -> None:
         if self.is_writer:
@@ -355,6 +461,20 @@ class Trainer:
             upto = pending[-1][0]
             rate = ((upto - last_log_step) * self.cfg.data.batch_size
                     / max(now - last_log_t, 1e-9))
+            # NaN/Inf guard scans the WHOLE window before anything is
+            # written: a mid-window raise would have already emitted the
+            # earlier records (log lines, TB scalars, step_callbacks)
+            # that the post-rollback re-run then emits again
+            if self.cfg.train.nan_guard:
+                for s, m, t in pending:
+                    loss = float(m["loss"])
+                    if not (math.isfinite(loss)
+                            and math.isfinite(float(m["train_acc"]))):
+                        self._recovery_event(
+                            {"layer": "train",
+                             "action": "nonfinite_loss_detected",
+                             "step": s, "loss": repr(loss)})
+                        raise _NonFiniteLoss(s, loss)
             for s, m, t in pending:
                 loss = float(m["loss"])
                 acc = float(m["train_acc"])
@@ -418,8 +538,15 @@ class Trainer:
 
         self.train_dir.mkdir(parents=True, exist_ok=True)
         step = self._start_step
+        rollbacks = 0
+        self._preempt_requested = None
+        saved_handlers = self._install_preempt_handlers()
         try:
-            while step < total:
+          # outer loop: one iteration per NaN-guard rollback episode —
+          # the inner loop re-enters from the restored step
+          while True:
+            try:
+              while step < total and self._preempt_requested is None:
                 feed = self.train_feed
                 in_window = profile_stop > profile_start and profile_start <= step < profile_stop
                 if in_window and not profiling and self.is_writer:
@@ -450,7 +577,11 @@ class Trainer:
                 if self._device_probe is not None:
                     if self.device_work_injection:
                         for _r, (fn, arg) in self.device_work_injection.items():
-                            fn(arg)  # async: queues real work on that device
+                            # async: queues real work on that device; the
+                            # probe polls the output's readiness so the
+                            # delay is attributed to the right replica
+                            # even on backends without per-device FIFO
+                            self._device_probe.note(_r, fn(arg))
                     self._last_device_skew = self._device_probe.measure_skew_ms()
                 step += 1
                 self.collector.add(
@@ -479,7 +610,36 @@ class Trainer:
                     self._save(step)
                 if cfg.save_results_period > 0 and step % cfg.save_results_period == 0:
                     self._dump_series()
+              flush(time.time())  # records past the last log boundary
+              break
+            except _NonFiniteLoss as e:
+                # NaN/Inf guard: discard the poisoned window, stop any
+                # open trace, roll back to the newest finite
+                # checkpoint and re-enter the loop from there. (If that
+                # checkpoint predates the last flushed window, the
+                # re-run appends the overlapping steps again — the same
+                # overlap a kill + resume produces; no poisoned window
+                # is ever written, per the flush pre-scan.)
+                pending.clear()
+                if tracing_step is not None:
+                    jax.profiler.stop_trace()
+                    tracing_step = None
+                if profiling:
+                    jax.profiler.stop_trace()
+                    profiling = False
+                rollbacks += 1
+                if rollbacks > self.cfg.train.nan_guard_max_rollbacks:
+                    raise RuntimeError(
+                        f"nonfinite loss recurred after "
+                        f"{rollbacks - 1} rollback(s) — deterministic "
+                        "divergence, giving up") from e
+                step = self._rollback_to_last_good(e)
+                last_log_step = step
+                last_log_t = time.time()
         finally:
+            if saved_handlers is not None:
+                for sig, old in saved_handlers.items():
+                    signal.signal(sig, old)
             if self._train_feed is not None:
                 # normal exit OR an exception escaping the loop: join
                 # the producer and re-sync the inner cursor to the
@@ -489,9 +649,12 @@ class Trainer:
                 # property would construct a fresh one after a swap)
                 self._train_feed.stop()
 
-        flush(time.time())  # records past the last log boundary
         if profiling:
             jax.profiler.stop_trace()
+        if self._preempt_requested:
+            self._recovery_event({"layer": "train", "action": "preempt_flush",
+                                  "signal": self._preempt_requested,
+                                  "step": step})
         # final save (≙ chief final saver.save, src/distributed_train.py:405-408)
         self._save(step)
         if self._checkpointer is not None:
@@ -505,10 +668,17 @@ class Trainer:
         if self._sink:
             self._sink.close()
             self._sink = None
+        if self._recovery_sink is not None:
+            self._recovery_sink.close()
+            self._recovery_sink = None
         summary = {
             "final_step": step,
             "updates_applied": int(jax.device_get(self.state.updates_applied)),
             "last_metrics": final_metrics,
             "timing": self.collector.report(),
+            # self-healing outcome: None/0 on a clean run; the CLI maps
+            # "preempted" to train.resumable_exit_code
+            "preempted": self._preempt_requested,
+            "nan_rollbacks": rollbacks,
         }
         return summary

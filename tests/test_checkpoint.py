@@ -2,6 +2,7 @@
 
 import numpy as np
 import jax
+import pytest
 
 from conftest import base_config
 from distributedmnist_tpu.train import checkpoint as ckpt
@@ -150,3 +151,169 @@ def test_sharded_snapshot_roundtrip_single_process(tmp_path):
     assert step == 3
     np.testing.assert_array_equal(restored["w"],
                                   np.arange(24, dtype=np.float32).reshape(6, 4))
+
+
+# ---------------------------------------------------------------------------
+# corruption fallback (robustness PR): checksums, torn writes, and the
+# previous-loadable-step fallback with journaled recovery events
+# ---------------------------------------------------------------------------
+
+def _dict_state(v: float):
+    return {"params": {"w": np.full((4, 3), v, np.float32)},
+            "step": np.int32(int(v))}
+
+
+def _save_two(tmp_path):
+    ckpt.save_checkpoint(tmp_path, _dict_state(3), 3)
+    ckpt.save_checkpoint(tmp_path, _dict_state(6), 6)
+
+
+@pytest.mark.tier1
+def test_truncated_latest_falls_back_to_previous_step(tmp_path):
+    """A torn write of the newest checkpoint (truncated msgpack) must
+    not wedge the resume: restore lands on the previous loadable step
+    and journals the fallback through the on_event hook."""
+    _save_two(tmp_path)
+    latest = tmp_path / "ckpt-00000006.msgpack"
+    latest.write_bytes(latest.read_bytes()[: latest.stat().st_size // 2])
+    events = []
+    restored = ckpt.restore_checkpoint(tmp_path, _dict_state(0),
+                                       on_event=events.append)
+    assert restored is not None
+    state, _, step = restored
+    assert step == 3
+    np.testing.assert_array_equal(state["params"]["w"],
+                                  np.full((4, 3), 3, np.float32))
+    actions = {e["action"]: e for e in events}
+    assert actions["corrupt_checkpoint_fallback"]["bad_step"] == 6
+    assert actions["fallback_restore"]["step"] == 3
+
+
+@pytest.mark.tier1
+def test_checksum_mismatch_detected_via_digest_sidecar(tmp_path):
+    """Bytes swapped out from under the digest sidecar (valid msgpack,
+    wrong content — silent corruption a parse can't see) are caught by
+    the sha256 check and fall back."""
+    from flax import serialization
+
+    _save_two(tmp_path)
+    assert (tmp_path / "ckpt-00000006.msgpack.sha256").exists()
+    # plausible but wrong bytes, written WITHOUT updating the sidecar
+    (tmp_path / "ckpt-00000006.msgpack").write_bytes(
+        serialization.msgpack_serialize(
+            {"state": {"params": {"w": np.zeros((4, 3), np.float32)}}}))
+    events = []
+    _, _, step = ckpt.restore_checkpoint(tmp_path, _dict_state(0),
+                                         on_event=events.append)
+    assert step == 3
+    assert any("sha256 mismatch" in e.get("error", "") for e in events)
+
+
+@pytest.mark.tier1
+def test_explicit_step_restore_raises_on_corruption(tmp_path):
+    """An explicitly requested step never falls back silently — the
+    caller asked for THAT step."""
+    _save_two(tmp_path)
+    latest = tmp_path / "ckpt-00000006.msgpack"
+    latest.write_bytes(b"\x00garbage")
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        ckpt.restore_checkpoint(tmp_path, _dict_state(0), step=6)
+
+
+@pytest.mark.tier1
+def test_corrupt_manifest_and_shard_fall_back(tmp_path):
+    """Sharded layout: a garbled manifest or a truncated shard at the
+    newest step both fall back to the previous complete step, and the
+    events are journaled."""
+    import json
+    from flax import serialization
+
+    def write_sharded(step, v):
+        shard = {"leaves": {"params/w": {
+            "indices": [[[0, 4], [0, 3]]],
+            "datas": [np.full((4, 3), v, np.float32)]}}}
+        (tmp_path / f"ckpt-{step:08d}.shard000-of-001.msgpack").write_bytes(
+            serialization.msgpack_serialize(shard))
+        manifest = {"step": step, "num_shards": 1,
+                    "leaves": {"params/w": {"shape": [4, 3],
+                                            "dtype": "float32"}},
+                    "extra": {}}
+        (tmp_path / f"ckpt-{step:08d}.manifest.json").write_text(
+            json.dumps(manifest))
+
+    template = {"params": {"w": np.zeros((4, 3), np.float32)}}
+    write_sharded(5, 5.0)
+    write_sharded(7, 7.0)
+
+    # (a) torn manifest at the newest step
+    mpath = tmp_path / "ckpt-00000007.manifest.json"
+    good_manifest = mpath.read_text()
+    mpath.write_text(good_manifest[: len(good_manifest) // 2])
+    events = []
+    state, _, step = ckpt.restore_checkpoint(tmp_path, template,
+                                             on_event=events.append)
+    assert step == 5
+    np.testing.assert_array_equal(state["params"]["w"],
+                                  np.full((4, 3), 5, np.float32))
+    assert any(e["action"] == "corrupt_checkpoint_fallback"
+               and e["bad_step"] == 7 for e in events)
+
+    # (b) manifest restored, shard truncated instead
+    mpath.write_text(good_manifest)
+    spath = tmp_path / "ckpt-00000007.shard000-of-001.msgpack"
+    spath.write_bytes(spath.read_bytes()[:10])
+    _, _, step = ckpt.restore_checkpoint(tmp_path, template)
+    assert step == 5
+
+
+@pytest.mark.tier1
+def test_io_retry_wrapper_absorbs_transient_errors():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert ckpt._io_retries(flaky, "flaky") == "ok"
+    assert len(calls) == 3
+
+    def missing():
+        raise FileNotFoundError("gone")
+
+    with pytest.raises(FileNotFoundError):  # permanent, no retries
+        ckpt._io_retries(missing, "missing")
+
+
+@pytest.mark.tier1
+def test_shard_missing_required_leaf_falls_back(tmp_path):
+    """A shard set that parses cleanly but lacks a leaf the state
+    requires (a swapped or half-written legacy shard) is damage to THAT
+    step — restore must fall back, not crash with a bare KeyError."""
+    import json
+    from flax import serialization
+
+    def write_sharded(step, leaves):
+        (tmp_path / f"ckpt-{step:08d}.shard000-of-001.msgpack").write_bytes(
+            serialization.msgpack_serialize({"leaves": leaves}))
+        manifest = {"step": step, "num_shards": 1,
+                    "leaves": {k: {"shape": [2], "dtype": "float32"}
+                               for k in leaves},
+                    "extra": {}}
+        (tmp_path / f"ckpt-{step:08d}.manifest.json").write_text(
+            json.dumps(manifest))
+
+    full = {"params/w": {"indices": [[[0, 2]]],
+                         "datas": [np.ones(2, np.float32)]},
+            "params/b": {"indices": [[[0, 2]]],
+                         "datas": [np.full(2, 2.0, np.float32)]}}
+    write_sharded(3, full)
+    write_sharded(9, {"params/w": full["params/w"]})  # b missing at 9
+
+    template = {"params": {"w": np.zeros(2, np.float32),
+                           "b": np.zeros(2, np.float32)}}
+    state, _, step = ckpt.restore_checkpoint(tmp_path, template)
+    assert step == 3
+    np.testing.assert_array_equal(state["params"]["b"],
+                                  np.full(2, 2.0, np.float32))

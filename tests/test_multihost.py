@@ -176,6 +176,10 @@ def test_two_process_training_matches_single_process(tmp_path):
     assert ev["num_examples"] == 96
 
 
+@pytest.mark.slow  # boots 2 real gloo worker processes; passes standalone
+# but under full-suite load reliably hits the known jaxlib-0.4.37 gloo
+# SIGABRT (gloo::EnforceNotMet pair.cc) — same crash its 3 slow-marked
+# siblings were quarantined for
 def test_two_process_quorum_gathers_on_every_host(tmp_path):
     """Quorum mode across two live processes: the k-of-n mask, the
     replicated [n] timing vector and the flags gather — the exact paths
