@@ -397,7 +397,10 @@ def main(argv=None) -> None:
     pc = sub.add_parser(
         "cluster", help="backend-pluggable cluster lifecycle "
                         "(local process-cluster or gcloud TPU-VM; "
-                        "fault plans, command journal)",
+                        "fault plans, command journal, supervised "
+                        "self-healing runs, seeded chaos campaigns "
+                        "with invariant checking — `cluster chaos "
+                        "--trials N --seed S --until-step M`)",
         add_help=False)
     pc.add_argument("rest", nargs=argparse.REMAINDER)
     pc.set_defaults(fn=_cluster)

@@ -1,0 +1,512 @@
+"""Chaos campaign engine: stop imagining fault scenarios by hand.
+
+The source paper's regime is synchronous training that *survives* dead
+and slow workers (arXiv:1604.00981), and its descendants treat replica
+loss as a routine runtime event with automatic recovery
+(TF-Replicator, arXiv:1902.00465; TensorFlow fault tolerance,
+arXiv:1605.08695). PRs 1–3 built both halves — injection
+(:class:`~.exec.FaultPlan`) and recovery (:class:`~.supervisor.
+ClusterSupervisor`, checkpoint fallback, NaN rollback) — but every
+scenario so far was a hand-authored test. This module searches the
+fault space mechanically:
+
+* :class:`ChaosSchedule` — a SEEDED random composition of fault
+  primitives (kill / hang / transient stall / corrupt-checkpoint /
+  exec delay) over workers × step windows with bounded intensity.
+  Same seed ⇒ same schedule: any journaled trial is replayable from
+  its seed alone.
+* :class:`ChaosCampaign` — runs N trials against a real
+  :class:`~.cluster.LocalProcessCluster` under a
+  :class:`~.supervisor.ClusterSupervisor`, plus one fault-free
+  same-seed REFERENCE run, then replays every trial's artifacts
+  through ``obsv/invariants.py`` — terminal-state legality, metrics-log
+  splicing, bitwise exact-resume determinism vs the reference, journal
+  causality, checkpoint-dir integrity.
+* **Shrinking** — a failing schedule is greedily reduced (drop faults
+  while the violation persists, re-running each candidate) and the
+  minimal reproducer is emitted as a plain FaultPlan JSON anyone can
+  rerun with ``cluster supervise --fault-plan``.
+
+CLI: ``python -m distributedmnist_tpu.launch cluster chaos
+--trials N --seed S --until-step M [--payload train|shell]``.
+The campaign leaves ``chaos_report.jsonl`` (one record per trial:
+schedule, outcome, invariant verdicts) under its workdir and prints
+the one-line summary from ``obsv.journal.summarize_chaos`` last.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import shutil
+import time
+from pathlib import Path
+from typing import Any
+
+from ..core.log import get_logger
+from ..obsv.invariants import check_run, shrink_faults
+from .cluster import (ClusterError, LocalClusterConfig, LocalProcessCluster)
+from .exec import CommandExecutor, FaultPlan, RetryPolicy
+from .supervisor import ClusterSupervisor, SupervisorConfig
+
+logger = get_logger("chaos")
+
+FAULT_KINDS = ("kill", "hang", "stall", "corrupt", "delay")
+
+# The cheap non-jax payload (the supervisor tests' resuming shell loop):
+# ~20 steps/s, a file "checkpoint" every 5 steps so restarts observably
+# resume. {limit} = step bound. No real checkpoints → the determinism
+# and integrity invariants report skipped, not fail.
+_SHELL_PAYLOAD = ('i=$( [ -f ckpt ] && cat ckpt || echo 0 ); '
+                  'echo $i >> boots.txt; '
+                  'while [ $i -lt {limit} ]; do i=$((i+1)); '
+                  'echo "{{\\"step\\": $i, \\"loss\\": 1.0}}" '
+                  '>> train_log.jsonl; '
+                  'if [ $((i % 5)) -eq 0 ]; then echo $i > ckpt; fi; '
+                  'sleep 0.05; done')
+
+# The real payload: an actual `launch train` worker — deterministic by
+# construction (fixed seed, synthetic data, float32, exact-resume
+# checkpoints), so a fully recovered trial must reproduce the
+# reference bitwise. {max_steps}/{save} templated from the config.
+_TRAIN_PAYLOAD = (
+    "python -m distributedmnist_tpu.launch train "
+    "train.train_dir=. data.dataset=synthetic data.batch_size=32 "
+    "data.synthetic_train_size=256 data.synthetic_test_size=64 "
+    "model.compute_dtype=float32 train.max_steps={max_steps} "
+    "train.log_every_steps=1 train.save_interval_steps={save} "
+    "train.async_checkpoint=false train.save_results_period=0")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosFault:
+    """One scheduled fault. ``ms`` is the stall duration (kind=stall)
+    or injected delay (kind=delay); ``verb`` names the delayed command
+    class (kind=delay only, worker ignored)."""
+
+    kind: str
+    worker: int = 0
+    step: int = 0
+    ms: float = 0.0
+    verb: str = ""
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {"kind": self.kind}
+        if self.kind == "delay":
+            d.update(verb=self.verb, ms=self.ms)
+        else:
+            d.update(worker=self.worker, step=self.step)
+            if self.kind == "stall":
+                d["ms"] = self.ms
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosSchedule:
+    """A seeded fault composition for one trial."""
+
+    seed: int
+    trial: int
+    faults: tuple[ChaosFault, ...]
+
+    def to_fault_plan(self) -> FaultPlan:
+        kill: dict[int, int] = {}
+        hang: dict[int, int] = {}
+        stall: dict[int, tuple[int, float]] = {}
+        corrupt: dict[int, int] = {}
+        delay: dict[str, float] = {}
+        for f in self.faults:
+            if f.kind == "kill":
+                kill[f.worker] = f.step
+            elif f.kind == "hang":
+                hang[f.worker] = f.step
+            elif f.kind == "stall":
+                stall[f.worker] = (f.step, f.ms)
+            elif f.kind == "corrupt":
+                corrupt[f.worker] = f.step
+            elif f.kind == "delay":
+                delay[f.verb] = f.ms
+            else:
+                raise ClusterError(f"unknown chaos fault kind {f.kind!r}")
+        return FaultPlan(kill_worker_at_step=kill,
+                         hang_worker_at_step=hang,
+                         stall_worker_for_ms_at_step=stall,
+                         corrupt_latest_checkpoint_at_step=corrupt,
+                         delay_ms=delay)
+
+    def to_json_dict(self) -> dict[str, Any]:
+        return {"seed": self.seed, "trial": self.trial,
+                "faults": [f.to_dict() for f in self.faults]}
+
+    def describe(self) -> str:
+        if not self.faults:
+            return "fault-free"
+        return " + ".join(
+            (f"{f.kind}(verb={f.verb}, {f.ms:.0f}ms)" if f.kind == "delay"
+             else f"{f.kind}(w{f.worker}@{f.step}"
+                  + (f", {f.ms:.0f}ms)" if f.kind == "stall" else ")"))
+            for f in self.faults)
+
+
+def generate_schedule(seed: int, trial: int, num_workers: int,
+                      step_window: tuple[int, int],
+                      max_faults: int = 3, min_faults: int = 1,
+                      stall_ms_range: tuple[float, float] = (500.0, 3000.0),
+                      delay_prob: float = 0.15) -> ChaosSchedule:
+    """Sample one bounded-intensity schedule. Deterministic in
+    (seed, trial). At most one fault of each kind per worker (the
+    FaultPlan dicts are worker-keyed). A ``corrupt`` draw always rides
+    with a ``kill`` at the SAME step — the torn checkpoint must
+    actually be HIT by a restarted worker's restore, not silently
+    overwritten — so if that worker's kill was already armed elsewhere
+    the corruption moves to the kill's step. ``max_faults`` bounds
+    intensity UNITS (a corrupt+kill pair is one unit; the fault list
+    may hold up to ``max_faults + 1`` entries)."""
+    import random
+    rng = random.Random(seed * 1_000_003 + trial)
+    lo, hi = step_window
+    hi = max(hi, lo)
+    n = rng.randint(min_faults, max(min_faults, max_faults))
+    combos = [(kind, w) for kind in ("kill", "hang", "stall", "corrupt")
+              for w in range(num_workers)]
+    rng.shuffle(combos)
+    faults: list[ChaosFault] = []
+    used: set[tuple[str, int]] = set()
+    units = 0
+
+    def arm(kind: str, w: int, step: int, ms: float = 0.0) -> bool:
+        if (kind, w) in used:
+            return False
+        used.add((kind, w))
+        faults.append(ChaosFault(kind=kind, worker=w, step=step, ms=ms))
+        return True
+
+    for kind, w in combos:
+        if units >= n:
+            break
+        step = rng.randint(lo, hi)
+        if kind == "stall":
+            if ("hang", w) in used:
+                continue  # the stall's timed SIGCONT would silently
+                # resume the "permanent" hang — mutually exclusive
+            units += arm(kind, w, step, ms=rng.uniform(*stall_ms_range))
+        elif kind == "hang":
+            if ("stall", w) in used:
+                continue
+            units += arm(kind, w, step)
+        elif kind == "corrupt":
+            paired_already = ("kill", w) in used
+            if paired_already:
+                # align with the worker's existing kill so the pairing
+                # invariant (same step) holds regardless of draw order
+                step = next(f.step for f in faults
+                            if f.kind == "kill" and f.worker == w)
+            if arm(kind, w, step):
+                arm("kill", w, step)
+                # the pair costs ONE unit total — the kill's unit was
+                # already charged when it was drawn first
+                units += 0 if paired_already else 1
+        else:
+            units += arm(kind, w, step)
+    if rng.random() < delay_prob:
+        faults.append(ChaosFault(
+            kind="delay", verb=rng.choice(("poll", "status", "progress")),
+            ms=rng.uniform(5.0, 50.0)))
+    return ChaosSchedule(seed=seed, trial=trial, faults=tuple(faults))
+
+
+# ---------------------------------------------------------------------------
+# campaign
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """Campaign knobs (JSON-loadable like every launch config)."""
+
+    name: str = "chaos"
+    trials: int = 5
+    seed: int = 0
+    until_step: int = 40
+    num_workers: int = 2
+    workdir: str = "/tmp/dmt_chaos"
+    # "train" = real `launch train` workers (all five invariants apply,
+    # incl. bitwise determinism); "shell" = the cheap 20-steps/s shell
+    # loop (no real checkpoints: determinism reports skipped) — for CI
+    # smoke and generator/checker development
+    payload: str = "train"
+    train_command: str = ""     # override; "" = built-in payload
+    save_interval_steps: int = 5
+    # schedule intensity
+    max_faults: int = 3
+    min_faults: int = 1
+    last_fault_frac: float = 0.5   # faults land in the run's first half
+    stall_ms_range: tuple[float, float] | None = None  # None = per-payload
+    # supervisor policy under test
+    quorum: int = 1
+    max_restarts: int = 2
+    restart_backoff_s: float = 0.3
+    stall_timeout_s: float | None = None  # None = per-payload default
+    poll_secs: float | None = None        # None = per-payload default
+    trial_timeout_s: float = 900.0
+    drain_timeout_s: float = 180.0
+    # drain gives up early on live workers whose logs stop moving for
+    # this long (a permanently-stopped straggler would otherwise hold
+    # every such trial for the full drain timeout); generous enough for
+    # a restarted worker's jax boot and a final save+eval tail
+    drain_stall_s: float = 45.0
+    # shrinking
+    shrink: bool = True
+    shrink_max_probes: int = 8
+
+    @classmethod
+    def from_file(cls, path: str | Path) -> "ChaosConfig":
+        d = json.loads(Path(path).read_text())
+        unknown = set(d) - {f.name for f in dataclasses.fields(cls)}
+        if unknown:
+            raise ClusterError(f"unknown chaos config keys: {sorted(unknown)}")
+        if "stall_ms_range" in d and d["stall_ms_range"] is not None:
+            d["stall_ms_range"] = tuple(d["stall_ms_range"])
+        return cls(**d)
+
+    # -- per-payload defaults -------------------------------------------
+
+    def resolved_poll_secs(self) -> float:
+        return self.poll_secs if self.poll_secs is not None else (
+            0.2 if self.payload == "shell" else 1.0)
+
+    def resolved_stall_timeout_s(self) -> float:
+        if self.stall_timeout_s is not None:
+            return self.stall_timeout_s
+        # the stall clock starts at the first poll, BEFORE the worker
+        # has logged anything — a real jax worker spends ~15-30 s
+        # booting, so the train-payload timeout must clear a full boot
+        # or healthy boots read as hangs
+        return 2.5 if self.payload == "shell" else 90.0
+
+    def resolved_stall_ms_range(self) -> tuple[float, float]:
+        if self.stall_ms_range is not None:
+            return self.stall_ms_range
+        # shell: straddle the stall timeout so the restart-vs-wait race
+        # runs both ways; train: always below it (a transient straggler
+        # the supervisor should WAIT out, never restart)
+        return (500.0, 4000.0) if self.payload == "shell" else (
+            2000.0, 8000.0)
+
+    def resolved_train_command(self) -> str:
+        if self.train_command:
+            return self.train_command
+        if self.payload == "shell":
+            return _SHELL_PAYLOAD.format(limit=self.until_step + 20)
+        return _TRAIN_PAYLOAD.format(max_steps=self.until_step,
+                                     save=self.save_interval_steps)
+
+    def step_window(self) -> tuple[int, int]:
+        lo = max(2, self.save_interval_steps + 1)
+        return (lo, max(lo, int(self.until_step * self.last_fault_frac)))
+
+    @property
+    def root(self) -> Path:
+        return Path(self.workdir) / self.name
+
+
+class ChaosCampaign:
+    """N seeded trials + a fault-free reference + invariant replay +
+    failing-schedule shrinking, over real local worker processes."""
+
+    def __init__(self, cfg: ChaosConfig):
+        self.cfg = cfg
+        self.reference_dir: Path | None = None
+
+    # -- one trial ------------------------------------------------------
+
+    def _run_trial(self, rel: str, plan: FaultPlan, seed: int,
+                   num_workers: int) -> dict[str, Any]:
+        """Execute one supervised run under ``plan`` in
+        ``<root>/<rel>``; returns the outcome record (also written to
+        ``outcome.json`` there so the invariant replay is
+        artifact-only)."""
+        cfg = self.cfg
+        target = cfg.until_step
+        lcfg = LocalClusterConfig(
+            name=rel, num_workers=num_workers, workdir=str(cfg.root),
+            train_command=cfg.resolved_train_command())
+        executor = CommandExecutor(
+            journal=lcfg.root / "command_journal.jsonl",
+            retry=RetryPolicy(max_attempts=1, seed=seed),
+            fault_plan=plan)
+        cluster = LocalProcessCluster(lcfg, executor)
+        scfg = SupervisorConfig(
+            quorum=min(cfg.quorum, num_workers),
+            max_restarts_per_worker=cfg.max_restarts,
+            restart_backoff_s=cfg.restart_backoff_s,
+            stall_timeout_s=cfg.resolved_stall_timeout_s(),
+            seed=seed)
+        sup = ClusterSupervisor(cluster, scfg)
+        outcome: dict[str, Any] = {
+            "name": rel, "seed": seed, "target": target,
+            "num_workers": num_workers,
+            "fault_plan": plan.to_json_dict(),
+            "supervisor": dataclasses.asdict(scfg),
+            "train_command": lcfg.train_command,
+            "reference_dir": (str(self.reference_dir)
+                              if self.reference_dir else None),
+        }
+        t0 = time.monotonic()
+        try:
+            # inside the try: a spawn that fails halfway (fork pressure
+            # mid-campaign) must still hit the kill_all/close below, or
+            # already-spawned detached workers outlive the campaign
+            cluster.create()
+            cluster.run_train()
+            got = sup.supervise_until_step(
+                target, poll_secs=cfg.resolved_poll_secs(),
+                timeout_secs=cfg.trial_timeout_s)
+            outcome.update(outcome="completed", step=got["step"],
+                           recovery=got.get("recovery"))
+            self._drain(cluster)
+        except ClusterError as e:
+            aborted = any(ev.get("action") == "below_quorum_abort"
+                          for ev in sup.events)
+            outcome.update(outcome="aborted" if aborted else "failed",
+                           step=None, error=str(e),
+                           recovery=sup.summary())
+        finally:
+            cluster.kill_all()
+            executor.close()
+        outcome["duration_s"] = round(time.monotonic() - t0, 3)
+        (lcfg.root / "outcome.json").write_text(
+            json.dumps(outcome, indent=2, default=str))
+        return outcome
+
+    def _drain(self, cluster: LocalProcessCluster) -> None:
+        """The supervisor returns when the FASTEST worker hits the
+        target; wait for the rest to finish their final save and exit
+        before teardown, or the determinism check would compare
+        checkpoints torn short by our own kill_all. Workers that died
+        for good (exhausted budget) are not waited for, and a live
+        worker whose log stops moving for a whole stall window (a
+        permanently SIGSTOPped straggler past its restart budget —
+        alive to kill -0 forever) is given up on early rather than
+        riding out the full drain timeout."""
+        deadline = time.monotonic() + self.cfg.drain_timeout_s
+        stall_window = self.cfg.drain_stall_s
+        last_progress: dict[int, int] = {}
+        moved_at = time.monotonic()
+        while time.monotonic() < deadline:
+            st = cluster.status()
+            if st is None or not any(w["alive"] for w in st["workers"]):
+                return
+            prog = cluster.worker_progress()
+            if prog != last_progress:
+                last_progress = prog
+                moved_at = time.monotonic()
+            elif time.monotonic() - moved_at >= stall_window:
+                logger.warning("drain: no log movement for %.0fs with "
+                               "workers still alive — giving up early",
+                               stall_window)
+                return
+            time.sleep(self.cfg.resolved_poll_secs())
+        logger.warning("drain timed out with workers still alive — "
+                       "tearing down anyway")
+
+    # -- the campaign ---------------------------------------------------
+
+    def run(self) -> dict[str, Any]:
+        cfg = self.cfg
+        if cfg.root.exists():
+            shutil.rmtree(cfg.root)  # stale trial state must not bleed in
+        cfg.root.mkdir(parents=True, exist_ok=True)
+        report_path = cfg.root / "chaos_report.jsonl"
+        records: list[dict[str, Any]] = []
+
+        # fault-free same-seed reference: ONE worker (every local
+        # worker runs the identical independent program, so one
+        # reference serves all of them)
+        logger.info("chaos: reference run (fault-free, 1 worker)")
+        ref = self._run_trial("reference", FaultPlan(), cfg.seed,
+                              num_workers=1)
+        if ref["outcome"] != "completed":
+            raise ClusterError(
+                f"chaos reference run did not complete: "
+                f"{ref.get('error', ref['outcome'])} — no baseline to "
+                "judge trials against")
+        self.reference_dir = cfg.root / "reference" / "worker0"
+
+        reproducer: dict[str, Any] | None = None
+        for t in range(cfg.trials):
+            schedule = generate_schedule(
+                cfg.seed, t, cfg.num_workers, cfg.step_window(),
+                max_faults=cfg.max_faults, min_faults=cfg.min_faults,
+                stall_ms_range=cfg.resolved_stall_ms_range())
+            logger.info("chaos trial %d/%d: %s", t + 1, cfg.trials,
+                        schedule.describe())
+            rel = f"trial{t:03d}"
+            outcome = self._run_trial(rel, schedule.to_fault_plan(),
+                                      cfg.seed, cfg.num_workers)
+            check = check_run(cfg.root / rel, outcome=outcome,
+                              reference_dir=self.reference_dir)
+            rec = {"event": "chaos_trial", "trial": t, "seed": cfg.seed,
+                   "schedule": schedule.to_json_dict(),
+                   "described": schedule.describe(),
+                   "outcome": outcome["outcome"], "step": outcome.get("step"),
+                   "target": cfg.until_step,
+                   "duration_s": outcome["duration_s"],
+                   "verdicts": check["verdicts"],
+                   "violations": check["violations"]}
+            if check["violations"] and cfg.shrink and reproducer is None:
+                shrunk = self._shrink(t, schedule, check)
+                rec["shrunk"] = shrunk
+                reproducer = shrunk
+            records.append(rec)
+            with open(report_path, "a") as fh:
+                fh.write(json.dumps(rec, default=str) + "\n")
+
+        from ..obsv.journal import summarize_chaos
+        summary = summarize_chaos(report_path)
+        summary["report_path"] = str(report_path)
+        (cfg.root / "chaos_report.json").write_text(
+            json.dumps(summary, default=str))
+        return summary
+
+    # -- shrinking ------------------------------------------------------
+
+    def _shrink(self, trial: int, schedule: ChaosSchedule,
+                check: dict[str, Any]) -> dict[str, Any]:
+        """Greedily reduce the failing schedule: drop faults while the
+        SAME invariant keeps failing (each probe is a full re-run +
+        re-check), then emit the minimal reproducer FaultPlan JSON."""
+        cfg = self.cfg
+        violated = {v["invariant"] for v in check["violations"]}
+        probes = [0]
+
+        def still_fails(faults: tuple[ChaosFault, ...]) -> bool:
+            cand = ChaosSchedule(seed=schedule.seed, trial=schedule.trial,
+                                 faults=faults)
+            rel = f"trial{trial:03d}_shrink{probes[0]:02d}"
+            probes[0] += 1
+            logger.info("shrink probe %s: %s", rel, cand.describe())
+            outcome = self._run_trial(rel, cand.to_fault_plan(), cfg.seed,
+                                      cfg.num_workers)
+            got = check_run(cfg.root / rel, outcome=outcome,
+                            reference_dir=self.reference_dir)
+            return bool({v["invariant"] for v in got["violations"]}
+                        & violated)
+
+        minimal, spent = shrink_faults(schedule.faults, still_fails,
+                                       max_probes=cfg.shrink_max_probes)
+        mini = ChaosSchedule(seed=schedule.seed, trial=schedule.trial,
+                             faults=minimal)
+        plan_path = cfg.root / f"reproducer_trial{trial:03d}.json"
+        plan_path.write_text(json.dumps(
+            mini.to_fault_plan().to_json_dict(), indent=2))
+        sched_path = cfg.root / f"reproducer_trial{trial:03d}_schedule.json"
+        sched_path.write_text(json.dumps(mini.to_json_dict(), indent=2))
+        return {"faults": [f.to_dict() for f in minimal],
+                "described": mini.describe(),
+                "invariants": sorted(violated), "probes": spent,
+                "fault_plan_path": str(plan_path),
+                "schedule_path": str(sched_path)}
+
+
+def run_campaign(cfg: ChaosConfig) -> dict[str, Any]:
+    return ChaosCampaign(cfg).run()

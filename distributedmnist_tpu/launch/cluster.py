@@ -50,17 +50,24 @@ class ClusterError(RuntimeError):
 def parse_poll_output(text: str | None) -> dict[str, Any]:
     """Parse the tail of a ``train_log.jsonl`` into {"step", "record"}.
 
-    step is -1 when the log does not exist yet (run still booting) or
-    the last line is a torn write — the next poll resolves it.
+    Scans BACKWARDS past a torn/non-JSON final line to the last intact
+    record: the writer may be mid-append when the tail runs, and
+    reporting step -1 for a whole poll tick makes live progress look
+    stalled — which a supervisor's ``stall_timeout_s`` could misread as
+    a hang. step is -1 only when no intact record exists at all (run
+    still booting, or the tail window held nothing but torn lines —
+    the next poll resolves it).
     """
-    lines = (text or "").strip().splitlines()
-    if not lines:
-        return {"step": -1, "record": None}
-    try:
-        record = json.loads(lines[-1])
-    except json.JSONDecodeError:
-        return {"step": -1, "record": None}
-    return {"step": int(record.get("step", -1)), "record": record}
+    for line in reversed((text or "").strip().splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            record = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # torn write — keep scanning backwards
+        return {"step": int(record.get("step", -1)), "record": record}
+    return {"step": -1, "record": None}
 
 
 class ClusterBackend(abc.ABC):
@@ -273,8 +280,10 @@ class GcloudTpuBackend(ClusterBackend):
         reference's master-log poll (tools/benchmark.py:24-34), against
         the structured log instead of a regex over freeform text."""
         log = shlex.quote(f"{self.cfg.remote_outdir}/train_log.jsonl")
+        # -n 3, not 1: a torn final line must leave an intact record in
+        # the window for parse_poll_output's backward scan
         out = self.runner.run(
-            self._ssh(f"tail -n 1 {log} 2>/dev/null || true", worker="0"),
+            self._ssh(f"tail -n 3 {log} 2>/dev/null || true", worker="0"),
             capture=True, check=False, verb="poll")
         if out is None:
             return None
@@ -574,7 +583,7 @@ class LocalProcessCluster(ClusterBackend):
         for w in state["workers"]:
             log = Path(w["logdir"]) / "train_log.jsonl"
             res = self.exec.run(
-                ["sh", "-c", f"tail -n 1 {shlex.quote(str(log))} "
+                ["sh", "-c", f"tail -n 3 {shlex.quote(str(log))} "
                              f"2>/dev/null || true"],
                 verb="progress", check=False, max_attempts=1)
             if res is None:  # dry-run
@@ -616,7 +625,8 @@ class LocalProcessCluster(ClusterBackend):
                    for kind, mapping in
                    (("kill", plan.kill_worker_at_step),
                     ("hang", plan.hang_worker_at_step),
-                    ("corrupt", plan.corrupt_latest_checkpoint_at_step))
+                    ("corrupt", plan.corrupt_latest_checkpoint_at_step),
+                    ("stall", plan.stall_worker_for_ms_at_step))
                    if any((kind, k) not in self._fault_fired
                           for k in mapping)]
         if not unfired:
@@ -649,6 +659,32 @@ class LocalProcessCluster(ClusterBackend):
                             {"event": "fault", "action": "hang_worker",
                              "worker": k, "pid": w["pid"],
                              "at_step": prog[k], "planned_step": s})
+        for k, (s, ms) in plan.stall_worker_for_ms_at_step.items():
+            if prog.get(k, -1) >= s and ("stall", k) not in self._fault_fired:
+                self._fault_fired.add(("stall", k))
+                for w in self._select(state["workers"], str(k)):
+                    if not w.get("pid"):
+                        continue
+                    pid = w["pid"]
+                    # STOP the whole group now; a detached subshell
+                    # CONTs it after the stall window — the resume must
+                    # not depend on the driver still polling (the
+                    # whole point is a straggler that recovers on its
+                    # OWN, racing any supervisor restart decision)
+                    secs = ms / 1e3
+                    self.exec.run(
+                        ["sh", "-c",
+                         f"kill -STOP -{pid} 2>/dev/null || "
+                         f"kill -STOP {pid} 2>/dev/null; "
+                         f"( sleep {secs}; "
+                         f"kill -CONT -{pid} 2>/dev/null || "
+                         f"kill -CONT {pid} 2>/dev/null ) "
+                         f">/dev/null 2>&1 &"],
+                        verb="fault", check=False)
+                    self.exec.journal(
+                        {"event": "fault", "action": "stall_worker",
+                         "worker": k, "pid": pid, "stall_ms": ms,
+                         "at_step": prog[k], "planned_step": s})
         for k, s in plan.corrupt_latest_checkpoint_at_step.items():
             if (prog.get(k, -1) >= s
                     and ("corrupt", k) not in self._fault_fired):
@@ -683,7 +719,7 @@ class LocalProcessCluster(ClusterBackend):
             return {"step": -1, "record": None}
         log = Path(state["workers"][0]["logdir"]) / "train_log.jsonl"
         out = self.exec.run(
-            ["sh", "-c", f"tail -n 1 {shlex.quote(str(log))} "
+            ["sh", "-c", f"tail -n 3 {shlex.quote(str(log))} "
                          f"2>/dev/null || true"],
             verb="poll", check=False)
         if out is None:  # dry-run: tail argv recorded above
@@ -729,7 +765,8 @@ def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(prog="distributedmnist_tpu.launch cluster")
     p.add_argument("action",
                    choices=["create", "delete", "status", "run", "kill-all",
-                            "exec", "download", "poll", "supervise"])
+                            "exec", "download", "poll", "supervise",
+                            "chaos"])
     p.add_argument("--backend", default="local", choices=["local", "gcloud"])
     p.add_argument("--config", default=None,
                    help="LocalClusterConfig / PodConfig JSON")
@@ -752,7 +789,10 @@ def main(argv: list[str] | None = None) -> None:
                    help="for run/poll/supervise: follow train_log.jsonl and "
                         "return at step N (run/supervise also stop the "
                         "cluster)")
-    p.add_argument("--poll-secs", type=float, default=5.0)
+    # None → 5.0 for run/poll/supervise; chaos resolves a per-payload
+    # default instead (0.2 shell / 1.0 train), so only an EXPLICIT
+    # flag may override it
+    p.add_argument("--poll-secs", type=float, default=None)
     p.add_argument("--poll-timeout-s", type=float, default=24 * 3600.0)
     p.add_argument("--supervisor-config", default=None,
                    help="for supervise: SupervisorConfig JSON (quorum, "
@@ -766,7 +806,59 @@ def main(argv: list[str] | None = None) -> None:
                    help="for supervise: base restart backoff")
     p.add_argument("--stall-timeout-s", type=float, default=None,
                    help="for supervise: hang detection window (0 = off)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="for supervise/chaos: schedule + retry-jitter "
+                        "seed, stamped on every journaled recovery/chaos "
+                        "event so an episode is replayable from the "
+                        "artifact alone")
+    p.add_argument("--trials", type=int, default=None,
+                   help="for chaos: number of seeded fault-schedule "
+                        "trials")
+    p.add_argument("--payload", default=None, choices=["train", "shell"],
+                   help="for chaos: real `launch train` workers (all "
+                        "invariants incl. bitwise determinism) or the "
+                        "cheap shell loop (CI smoke)")
+    p.add_argument("--chaos-config", default=None,
+                   help="for chaos: ChaosConfig JSON (flags above "
+                        "override it)")
+    p.add_argument("--no-shrink", action="store_true",
+                   help="for chaos: skip minimizing failing schedules")
     args = p.parse_args(argv)
+    poll_secs = 5.0 if args.poll_secs is None else args.poll_secs
+
+    if args.action == "chaos":
+        # the campaign owns its clusters/executors (one per trial, all
+        # local, fault plans generated from the seed) — flags that
+        # would silently be discarded must error instead
+        for flag, val in (("--backend", args.backend != "local"),
+                          ("--dry-run", args.dry_run),
+                          ("--fault-plan", args.fault_plan is not None),
+                          ("--config", args.config is not None),
+                          ("--journal", args.journal is not None),
+                          ("--timeout-s", args.timeout_s is not None)):
+            if val:
+                p.error(f"{flag} does not apply to chaos — campaigns run "
+                        "local clusters with seed-generated fault plans "
+                        "(use --chaos-config)")
+        from .chaos import ChaosConfig, run_campaign
+        ccfg = (ChaosConfig.from_file(args.chaos_config)
+                if args.chaos_config else ChaosConfig())
+        overrides = {"trials": args.trials, "seed": args.seed,
+                     "until_step": args.until_step,
+                     "payload": args.payload,
+                     # the supervisor policy under test — same flags as
+                     # `supervise`, mapped onto the campaign config
+                     "quorum": args.quorum,
+                     "max_restarts": args.max_restarts,
+                     "restart_backoff_s": args.restart_backoff_s,
+                     "stall_timeout_s": args.stall_timeout_s,
+                     "poll_secs": args.poll_secs}
+        ccfg = dataclasses.replace(
+            ccfg, **{k: v for k, v in overrides.items() if v is not None})
+        if args.no_shrink:
+            ccfg = dataclasses.replace(ccfg, shrink=False)
+        print(json.dumps(run_campaign(ccfg), default=str))
+        return
 
     fault = FaultPlan.from_file(args.fault_plan) if args.fault_plan else None
     journal = args.journal
@@ -777,7 +869,7 @@ def main(argv: list[str] | None = None) -> None:
         journal = cfg0.root / "command_journal.jsonl"
     executor = CommandExecutor(
         journal=journal,
-        retry=RetryPolicy(max_attempts=args.max_attempts),
+        retry=RetryPolicy(max_attempts=args.max_attempts, seed=args.seed),
         timeout_s=args.timeout_s, fault_plan=fault, dry_run=args.dry_run)
     backend = make_backend(args.backend, args.config, executor)
 
@@ -790,7 +882,7 @@ def main(argv: list[str] | None = None) -> None:
     elif args.action == "run":
         if args.until_step is not None:
             print(json.dumps(run_until_step(
-                backend, args.until_step, poll_secs=args.poll_secs,
+                backend, args.until_step, poll_secs=poll_secs,
                 timeout_secs=args.poll_timeout_s)))
         else:
             backend.run_train()
@@ -803,17 +895,18 @@ def main(argv: list[str] | None = None) -> None:
         overrides = {"quorum": args.quorum,
                      "max_restarts_per_worker": args.max_restarts,
                      "restart_backoff_s": args.restart_backoff_s,
-                     "stall_timeout_s": args.stall_timeout_s}
+                     "stall_timeout_s": args.stall_timeout_s,
+                     "seed": args.seed}
         scfg = dataclasses.replace(
             scfg, **{k: v for k, v in overrides.items() if v is not None})
         sup = ClusterSupervisor(backend, scfg)
         print(json.dumps(sup.run_until_step(
-            args.until_step, poll_secs=args.poll_secs,
+            args.until_step, poll_secs=poll_secs,
             timeout_secs=args.poll_timeout_s)))
     elif args.action == "poll":
         if args.until_step is not None:
             print(json.dumps(wait_until_step(
-                backend, args.until_step, poll_secs=args.poll_secs,
+                backend, args.until_step, poll_secs=poll_secs,
                 timeout_secs=args.poll_timeout_s)))
         else:
             print(json.dumps(backend.poll()))

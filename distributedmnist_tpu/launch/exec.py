@@ -115,6 +115,15 @@ class FaultPlan:
                                          restarted worker must fall
                                          back to the previous loadable
                                          step)
+    ``stall_worker_for_ms_at_step`` {k: [s, ms]} — SIGSTOP worker ``k``
+                                         at its step >= ``s`` and
+                                         SIGCONT it ``ms`` later: a
+                                         TRANSIENT straggler that
+                                         recovers on its own, unlike
+                                         the permanent hang — the
+                                         restart-vs-wait race against a
+                                         supervisor's stall timeout is
+                                         only testable with this one
 
     Every action fires at most once per worker per run.
     """
@@ -127,13 +136,20 @@ class FaultPlan:
         default_factory=dict)
     corrupt_latest_checkpoint_at_step: dict[int, int] = dataclasses.field(
         default_factory=dict)
+    # {worker: (trigger_step, stall_duration_ms)}
+    stall_worker_for_ms_at_step: dict[int, tuple[int, float]] = \
+        dataclasses.field(default_factory=dict)
 
     _WORKER_KEYED = ("kill_worker_at_step", "hang_worker_at_step",
                      "corrupt_latest_checkpoint_at_step")
 
     @classmethod
     def from_file(cls, path: str | Path) -> "FaultPlan":
-        d = json.loads(Path(path).read_text())
+        return cls.from_json_dict(json.loads(Path(path).read_text()))
+
+    @classmethod
+    def from_json_dict(cls, d: dict) -> "FaultPlan":
+        d = dict(d)
         unknown = set(d) - {f.name for f in dataclasses.fields(cls)}
         if unknown:
             raise ExecError(f"unknown fault plan keys: {sorted(unknown)}")
@@ -141,7 +157,27 @@ class FaultPlan:
         for key in cls._WORKER_KEYED:
             if key in d:
                 d[key] = {int(k): int(v) for k, v in d[key].items()}
+        if "stall_worker_for_ms_at_step" in d:
+            d["stall_worker_for_ms_at_step"] = {
+                int(k): (int(v[0]), float(v[1]))
+                for k, v in d["stall_worker_for_ms_at_step"].items()}
         return cls(**d)
+
+    def to_json_dict(self) -> dict:
+        """The file-format view (string keys, lists for tuples) — what
+        ``from_file`` reads back; empty actions omitted. The chaos
+        engine emits shrunk reproducers through this."""
+        out: dict = {}
+        for f in dataclasses.fields(self):
+            val = getattr(self, f.name)
+            if not val:
+                continue
+            if isinstance(val, dict):
+                out[f.name] = {str(k): (list(v) if isinstance(v, tuple)
+                                        else v) for k, v in val.items()}
+            else:
+                out[f.name] = val
+        return out
 
     def should_fail(self, verb: str, attempt: int) -> bool:
         return attempt <= self.fail_first.get(verb, 0)

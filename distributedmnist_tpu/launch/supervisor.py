@@ -68,6 +68,11 @@ class SupervisorConfig:
     # progress for this long (while the pid stays alive) is killed and
     # restarted under the same budget
     stall_timeout_s: float = 0.0
+    # The run's schedule seed (chaos campaigns / `--seed`): stamped on
+    # every recovery event so a journaled episode is replayable from
+    # the artifact alone — the seed regenerates the fault schedule and
+    # the jitter sequence that produced it. None = unseeded run.
+    seed: int | None = None
 
     def __post_init__(self) -> None:
         if self.quorum < 1:
@@ -106,6 +111,8 @@ class ClusterSupervisor:
     def _event(self, action: str, **fields: Any) -> None:
         rec = {"event": "recovery", "layer": "supervisor",
                "action": action, "time": time.time(), **fields}
+        if self.cfg.seed is not None:
+            rec.setdefault("seed", self.cfg.seed)
         self.events.append(rec)
         logger.info("recovery: %s %s", action,
                     {k: v for k, v in fields.items() if k != "time"})

@@ -1,0 +1,447 @@
+"""Post-run invariant checking: is a *recovered* run a *correct* run?
+
+PRs 1–3 built fault injection (``launch/exec.py`` FaultPlan) and fault
+recovery (``launch/supervisor.py``, checkpoint fallback, NaN rollback);
+every scenario so far asserted its own hand-written expectations. This
+module is the machine-checked half of the chaos campaign
+(``launch/chaos.py``): it replays a finished run's ARTIFACTS ALONE —
+``train_log.jsonl``, the command journal, the recovery journals, the
+checkpoint dir — and verifies the five end-to-end invariants any
+survived fault schedule must satisfy:
+
+1. **terminal_state** — the run reached its target step, or aborted
+   only the way the quorum policy allows (a journaled
+   ``below_quorum_abort`` with the restart budget respected).
+2. **metrics_log** — the step series is gap-free and duplicate-free
+   after rollback splicing, and every rewind in the log is explained
+   by a journaled recovery event (an unexplained duplicate record is
+   exactly how a buggy rollback would corrupt every downstream report).
+3. **determinism** — a faulted-but-fully-recovered worker's final
+   params are BITWISE equal to a fault-free same-seed reference run's
+   (``train/checkpoint.py`` params digests).
+4. **causality** — every ``restart`` is preceded by a ``detect``,
+   every ``fallback_restore`` by a corruption/IO event: recovery
+   actions without recorded causes mean the journal lies.
+5. **checkpoint_integrity** — every digest sidecar in the checkpoint
+   dir verifies (deliberately-torn fault targets journaled by the
+   injector are exempt) and the manifest pointer resolves.
+
+No cluster, supervisor, or trainer state is consulted — a report over
+downloaded artifacts is as checkable as a live run, which is what lets
+the chaos campaign shrink failing schedules by re-running and
+re-checking mechanically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Any, Callable
+
+from .report import load_jsonl
+
+INVARIANTS = ("terminal_state", "metrics_log", "determinism",
+              "causality", "checkpoint_integrity")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    invariant: str          # one of INVARIANTS
+    detail: str
+    worker: int | None = None
+
+    def to_dict(self) -> dict[str, Any]:
+        d = {"invariant": self.invariant, "detail": self.detail}
+        if self.worker is not None:
+            d["worker"] = self.worker
+        return d
+
+
+# ---------------------------------------------------------------------------
+# (2) metrics log: rollback splicing + gap/duplicate checking
+# ---------------------------------------------------------------------------
+
+def splice_rollbacks(steps: list[dict]) -> tuple[list[dict], int]:
+    """Replay the append-ordered step records through rewind-supersede
+    splicing: when a record's step is <= the previous one (a rollback
+    or restart-resume re-ran that span), the superseded suffix is
+    dropped and the re-run records take its place — the same view a
+    log consumer must take after any rollback. Returns the spliced
+    series (strictly increasing by construction) and the number of
+    rewinds observed."""
+    out: list[dict] = []
+    rewinds = 0
+    for rec in steps:
+        s = rec.get("step")
+        if not isinstance(s, int):
+            continue
+        if out and s <= out[-1]["step"]:
+            rewinds += 1
+            while out and out[-1]["step"] >= s:
+                out.pop()
+        out.append(rec)
+    return out, rewinds
+
+
+def check_metrics_log(steps: list[dict], allowed_rewinds: int | None = None,
+                      worker: int | None = None) -> list[Violation]:
+    """Invariant (2) over one worker's step records.
+
+    ``allowed_rewinds``: how many rewinds the recovery journals justify
+    (restarts + NaN rollbacks). None skips the explanation check (a
+    bare log with no journal context). A rewind count EXCEEDING the
+    justified one is how a doctored/duplicated record — or a rollback
+    that re-emitted a window it already wrote — surfaces."""
+    out: list[Violation] = []
+    if not steps:
+        return [Violation("metrics_log", "no step records at all", worker)]
+    spliced, rewinds = splice_rollbacks(steps)
+    if allowed_rewinds is not None and rewinds > allowed_rewinds:
+        out.append(Violation(
+            "metrics_log",
+            f"{rewinds} rewind(s) in the step series but only "
+            f"{allowed_rewinds} journaled recovery cause(s) — "
+            "duplicated or re-emitted step records", worker))
+    if spliced and spliced[0]["step"] != 1:
+        out.append(Violation(
+            "metrics_log",
+            f"spliced series starts at step {spliced[0]['step']}, not 1 "
+            "(missing leading records)", worker))
+    for prev, rec in zip(spliced, spliced[1:]):
+        if rec["step"] != prev["step"] + 1:
+            out.append(Violation(
+                "metrics_log",
+                f"gap in spliced series: step {prev['step']} -> "
+                f"{rec['step']}", worker))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# (1) terminal-state legality and (4) journal causality
+# ---------------------------------------------------------------------------
+
+def check_terminal_state(outcome: dict, recovery_events: list[dict]
+                         ) -> list[Violation]:
+    """Invariant (1): ``outcome`` is the campaign's trial record
+    ({"outcome", "step", "target", "supervisor": SupervisorConfig
+    fields}); legality is judged against the journaled events."""
+    out: list[Violation] = []
+    target = outcome.get("target", 0)
+    kind = outcome.get("outcome")
+    aborts = [r for r in recovery_events
+              if r.get("action") == "below_quorum_abort"]
+    if kind == "completed":
+        if outcome.get("step", -1) < target:
+            out.append(Violation(
+                "terminal_state",
+                f"trial reported completed at step {outcome.get('step')} "
+                f"< target {target}"))
+        if aborts:
+            out.append(Violation(
+                "terminal_state",
+                "completed trial has a below_quorum_abort event"))
+    elif kind == "aborted":
+        if not aborts:
+            out.append(Violation(
+                "terminal_state",
+                "aborted without a journaled below_quorum_abort — the "
+                "quorum policy never sanctioned this exit"))
+        else:
+            quorum = (outcome.get("supervisor") or {}).get("quorum")
+            rec = aborts[-1]
+            if (quorum is not None and rec.get("workers_alive") is not None
+                    and rec["workers_alive"] >= quorum):
+                out.append(Violation(
+                    "terminal_state",
+                    f"abort with workers_alive={rec['workers_alive']} >= "
+                    f"quorum {quorum}"))
+    else:
+        out.append(Violation(
+            "terminal_state",
+            f"illegal terminal state {kind!r}: "
+            f"{outcome.get('error', 'no error recorded')}"))
+    # restart budget respected regardless of the terminal kind
+    budget = (outcome.get("supervisor") or {}).get("max_restarts_per_worker")
+    if budget is not None:
+        per_worker: dict[int, int] = {}
+        for r in recovery_events:
+            if r.get("action") == "restart" and "worker" in r:
+                per_worker[r["worker"]] = per_worker.get(r["worker"], 0) + 1
+        for k, n in sorted(per_worker.items()):
+            if n > budget:
+                out.append(Violation(
+                    "terminal_state",
+                    f"{n} restarts > budget {budget}", k))
+    return out
+
+
+def check_causality(recovery_events: list[dict],
+                    worker_events: dict[int, list[dict]]) -> list[Violation]:
+    """Invariant (4). ``recovery_events``: the supervisor's records from
+    the command journal; ``worker_events``: each worker's own
+    ``recovery_journal.jsonl`` records."""
+    out: list[Violation] = []
+    chains: dict[int, list[str]] = {}
+    for r in recovery_events:
+        if "worker" in r:
+            chains.setdefault(r["worker"], []).append(r.get("action", "?"))
+    for k, chain in sorted(chains.items()):
+        detects = restarts = 0
+        for action in chain:
+            detects += action == "detect"
+            restarts += action == "restart"
+            if restarts > detects:
+                out.append(Violation(
+                    "causality",
+                    f"restart #{restarts} not preceded by a detect "
+                    f"(chain: {chain})", k))
+                break
+    for k, events in sorted(worker_events.items()):
+        causes = restores = 0
+        for r in events:
+            action = r.get("action")
+            causes += action in ("corrupt_checkpoint_fallback",
+                                 "rollback_candidate_unusable")
+            restores += action == "fallback_restore"
+            if restores > causes:
+                out.append(Violation(
+                    "causality",
+                    "fallback_restore without a preceding corruption/IO "
+                    "event in the worker recovery journal", k))
+                break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# (5) checkpoint-dir integrity
+# ---------------------------------------------------------------------------
+
+def check_checkpoint_dir(logdir: str | Path, exempt: set[str] = frozenset(),
+                         worker: int | None = None) -> list[Violation]:
+    """Invariant (5) over one worker's logdir. ``exempt``: artifact
+    names the command journal records as DELIBERATELY torn by the fault
+    injector — finding those corrupt is the plan working, any other
+    mismatch is damage nobody injected."""
+    from ..train.checkpoint import CheckpointCorruptError, verify_artifact
+    logdir = Path(logdir)
+    out: list[Violation] = []
+    for sidecar in sorted(logdir.glob("ckpt-*.sha256")):
+        data_file = sidecar.with_suffix("")  # strip the .sha256 suffix
+        if data_file.name in exempt:
+            continue
+        if not data_file.exists():
+            out.append(Violation(
+                "checkpoint_integrity",
+                f"digest sidecar {sidecar.name} has no data file", worker))
+            continue
+        try:
+            # the ONE sidecar contract (train/checkpoint.py) — the
+            # checker must verify what the writer actually promises
+            verify_artifact(data_file)
+        except CheckpointCorruptError as e:
+            out.append(Violation(
+                "checkpoint_integrity", str(e), worker))
+    pointer = logdir / "checkpoint.json"
+    if pointer.exists():
+        try:
+            d = json.loads(pointer.read_text())
+            target = logdir / d["latest_path"]
+        except (json.JSONDecodeError, KeyError, TypeError) as e:
+            out.append(Violation(
+                "checkpoint_integrity",
+                f"checkpoint.json unreadable ({e})", worker))
+        else:
+            if not target.exists():
+                out.append(Violation(
+                    "checkpoint_integrity",
+                    f"pointer names {target.name} which does not exist",
+                    worker))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# (3) exact-resume determinism
+# ---------------------------------------------------------------------------
+
+def determinism_verdict(logdir: str | Path, reference_dir: str | Path,
+                        worker: int | None = None,
+                        reference_digest: tuple[str, int] | None = None
+                        ) -> tuple[bool, list[Violation]]:
+    """Invariant (3): the worker's final checkpoint params must be
+    BITWISE equal to the fault-free same-seed reference run's.
+
+    Returns ``(checked, violations)``. The comparison only applies to a
+    FULLY recovered worker — one whose latest loadable checkpoint
+    reached the reference's final step; a worker left behind (exhausted
+    restart budget, or a latest checkpoint the injector deliberately
+    tore and nothing ever re-saved) yields ``checked=False`` rather
+    than a comparison against a further-along reference."""
+    from ..train.checkpoint import (CheckpointCorruptError,
+                                    checkpoint_params_digest)
+    try:
+        ref = (reference_digest if reference_digest is not None
+               else checkpoint_params_digest(reference_dir))
+    except CheckpointCorruptError as e:
+        return True, [Violation(
+            "determinism", f"reference checkpoint unreadable: {e}", worker)]
+    if ref is None:
+        # the payload writes no real checkpoints (shell smoke runs):
+        # there is no bitwise claim to make — skipped, not violated
+        return False, []
+    try:
+        got = checkpoint_params_digest(logdir)
+    except CheckpointCorruptError:
+        return False, []  # torn latest, never re-saved: not recovered
+    if got is None or got[1] != ref[1]:
+        return False, []  # never reached the reference step
+    if got[0] != ref[0]:
+        return True, [Violation(
+            "determinism",
+            f"final params at step {got[1]} differ bitwise from the "
+            f"fault-free reference ({got[0][:12]}… != {ref[0][:12]}…)",
+            worker)]
+    return True, []
+
+
+# ---------------------------------------------------------------------------
+# whole-run replay
+# ---------------------------------------------------------------------------
+
+def _worker_dirs(trial_dir: Path) -> dict[int, Path]:
+    out = {}
+    for d in sorted(trial_dir.glob("worker*")):
+        if d.is_dir() and d.name[len("worker"):].isdigit():
+            out[int(d.name[len("worker"):])] = d
+    return out
+
+
+def corruption_exempt_targets(journal_records: list[dict]
+                              ) -> dict[int, set[str]]:
+    """{worker: {artifact names}} the fault injector journaled as
+    deliberately torn — exempt from invariant (5)."""
+    out: dict[int, set[str]] = {}
+    for r in journal_records:
+        if (r.get("event") == "fault"
+                and r.get("action") == "corrupt_latest_checkpoint"
+                and r.get("target")):
+            out.setdefault(r.get("worker", -1), set()).add(r["target"])
+    return out
+
+
+def check_run(trial_dir: str | Path, outcome: dict | None = None,
+              reference_dir: str | Path | None = None) -> dict[str, Any]:
+    """Replay one trial's artifact set and verify all five invariants.
+
+    ``trial_dir`` is a LocalProcessCluster root: ``worker<k>/`` logdirs
+    plus ``command_journal.jsonl``; the campaign also leaves
+    ``outcome.json`` (trial metadata) there, or the caller passes
+    ``outcome`` directly. Returns ``{"verdicts": {invariant:
+    pass|fail|skipped}, "violations": [...], "workers": [...]}``.
+    """
+    trial_dir = Path(trial_dir)
+    if outcome is None:
+        opath = trial_dir / "outcome.json"
+        outcome = (json.loads(opath.read_text()) if opath.exists() else {})
+    if reference_dir is None and outcome.get("reference_dir"):
+        reference_dir = outcome["reference_dir"]
+
+    journal_all = load_jsonl(trial_dir / "command_journal.jsonl")
+    recovery = [r for r in journal_all if r.get("event") == "recovery"]
+    workers = _worker_dirs(trial_dir)
+    worker_events = {k: load_jsonl(d / "recovery_journal.jsonl", "recovery")
+                     for k, d in workers.items()}
+    exempt = corruption_exempt_targets(journal_all)
+
+    violations: list[Violation] = []
+    skipped: set[str] = set()
+
+    # the reference checkpoint is immutable once its run completed:
+    # digest it ONCE per check, not once per worker
+    ref_digest: tuple[str, int] | None = None
+    if reference_dir is not None:
+        from ..train.checkpoint import (CheckpointCorruptError,
+                                        checkpoint_params_digest)
+        try:
+            ref_digest = checkpoint_params_digest(reference_dir)
+        except CheckpointCorruptError as e:
+            violations.append(Violation(
+                "determinism", f"reference checkpoint unreadable: {e}"))
+        if ref_digest is None:
+            reference_dir = None  # nothing to compare against → skip
+
+    violations += check_terminal_state(outcome, recovery)
+    violations += check_causality(recovery, worker_events)
+
+    restarts_by_worker: dict[int, int] = {}
+    for r in recovery:
+        if r.get("action") == "restart" and "worker" in r:
+            restarts_by_worker[r["worker"]] = (
+                restarts_by_worker.get(r["worker"], 0) + 1)
+
+    det_checked = 0
+    for k, d in sorted(workers.items()):
+        # the trainer stamps event:"step"; minimal payloads (chaos
+        # shell smoke, the reference's own tools) may write bare
+        # {"step": N, ...} records — both are the metrics series
+        steps = [r for r in load_jsonl(d / "train_log.jsonl")
+                 if isinstance(r.get("step"), int)
+                 and r.get("event", "step") == "step"]
+        allowed = (restarts_by_worker.get(k, 0)
+                   + sum(1 for r in worker_events.get(k, [])
+                         if r.get("action") in ("nan_rollback",
+                                                "fallback_restore")))
+        violations += check_metrics_log(steps, allowed_rewinds=allowed,
+                                        worker=k)
+        violations += check_checkpoint_dir(d, exempt.get(k, set()), worker=k)
+        if reference_dir is not None:
+            checked, det_violations = determinism_verdict(
+                d, reference_dir, worker=k, reference_digest=ref_digest)
+            violations += det_violations
+            det_checked += checked
+    if reference_dir is None:
+        skipped.add("determinism")
+    elif det_checked == 0:
+        # every worker was left short of the reference step — nothing
+        # was "fully recovered", so the bitwise claim has no subject
+        skipped.add("determinism")
+
+    failed = {v.invariant for v in violations}
+    verdicts = {inv: ("fail" if inv in failed
+                      else "skipped" if inv in skipped else "pass")
+                for inv in INVARIANTS}
+    return {"verdicts": verdicts,
+            "violations": [v.to_dict() for v in violations],
+            "workers": sorted(workers),
+            "determinism_workers_checked": det_checked}
+
+
+# ---------------------------------------------------------------------------
+# schedule shrinking (used by launch/chaos.py; lives here so the
+# reduction is defined next to the predicate it minimizes against)
+# ---------------------------------------------------------------------------
+
+def shrink_faults(faults: tuple, still_fails: Callable[[tuple], bool],
+                  max_probes: int = 32) -> tuple[tuple, int]:
+    """Greedy one-at-a-time reduction: repeatedly try dropping each
+    fault; keep any drop under which the violation persists
+    (``still_fails(candidate)`` True). Returns (minimal fault tuple,
+    probes spent). The classic ddmin endgame without the partitioning
+    prelude — chaos schedules are small (a handful of faults), so the
+    linear pass converges in O(n²) probes worst-case, bounded by
+    ``max_probes``."""
+    current = tuple(faults)
+    probes = 0
+    changed = True
+    while changed and len(current) > 1 and probes < max_probes:
+        changed = False
+        for i in range(len(current)):
+            cand = current[:i] + current[i + 1:]
+            probes += 1
+            if still_fails(cand):
+                current = cand
+                changed = True
+                break
+            if probes >= max_probes:
+                break
+    return current, probes

@@ -65,6 +65,44 @@ def summarize_recovery(path: str | Path) -> dict[str, Any]:
     return summarize_recovery_events(load_recovery_events(path))
 
 
+def summarize_chaos(path: str | Path) -> dict[str, Any]:
+    """Aggregate a chaos campaign's ``chaos_report.jsonl`` (one
+    ``event: "chaos_trial"`` record per trial, written by
+    ``launch/chaos.py``) into the single-line campaign verdict: trial
+    outcomes, per-invariant pass/fail/skip tallies, which trials
+    violated what, and any shrunk reproducer paths. ``all_green`` means
+    every trial passed every applicable invariant — the regression
+    signal a scheduled chaos sweep gates on."""
+    records = load_jsonl(path, event="chaos_trial")
+    outcomes: dict[str, int] = {}
+    by_invariant: dict[str, dict[str, int]] = {}
+    failing: list[dict[str, Any]] = []
+    reproducers: list[str] = []
+    for rec in records:
+        outcomes[rec.get("outcome", "?")] = (
+            outcomes.get(rec.get("outcome", "?"), 0) + 1)
+        for inv, verdict in (rec.get("verdicts") or {}).items():
+            slot = by_invariant.setdefault(
+                inv, {"pass": 0, "fail": 0, "skipped": 0})
+            slot[verdict] = slot.get(verdict, 0) + 1
+        if rec.get("violations"):
+            failing.append({
+                "trial": rec.get("trial"),
+                "schedule": rec.get("described"),
+                "invariants": sorted({v["invariant"]
+                                      for v in rec["violations"]})})
+        shrunk = rec.get("shrunk")
+        if shrunk and shrunk.get("fault_plan_path"):
+            reproducers.append(shrunk["fault_plan_path"])
+    return {"trials": len(records),
+            "seed": records[0].get("seed") if records else None,
+            "outcomes": outcomes,
+            "invariants": by_invariant,
+            "all_green": not failing and bool(records),
+            "failing_trials": failing,
+            "reproducers": reproducers}
+
+
 def summarize_journal(path: str | Path) -> dict[str, Any]:
     """Aggregate a command journal into run-level evidence.
 

@@ -59,8 +59,18 @@ def load_experiment(train_dir: str | Path,
     or None, "time_acc": [S,4] array or None}.
     """
     train_dir = Path(train_dir)
+    # Rollback splicing (obsv/invariants.py): after a NaN rollback or a
+    # restart-resume the append-only log re-emits the replayed span, so
+    # the raw series doubles back. Every stat/figure consumer wants the
+    # spliced monotone view (identical to raw for a clean run); the raw
+    # records stay available under "steps_raw".
+    from .invariants import splice_rollbacks
+    raw_steps = load_jsonl(train_dir / "train_log.jsonl", "step")
+    spliced_steps, rewinds = splice_rollbacks(raw_steps)
     data: dict[str, Any] = {
-        "steps": load_jsonl(train_dir / "train_log.jsonl", "step"),
+        "steps": spliced_steps,
+        "steps_raw": raw_steps,
+        "log_rewinds": rewinds,
         "evals": [],
         "step_times": None,
         "time_acc": None,
@@ -98,6 +108,8 @@ def experiment_stats(data: dict[str, Any]) -> dict[str, Any]:
         if rates:
             out["examples_per_sec"] = {"mean": float(np.mean(rates)),
                                        "max": float(np.max(rates))}
+    if data.get("log_rewinds"):
+        out["log_rewinds"] = data["log_rewinds"]
     if data["evals"]:
         best = max(e["precision_at_1"] for e in data["evals"])
         out["best_precision_at_1"] = best

@@ -206,6 +206,16 @@ def _verified_read(path: Path) -> bytes:
     return data
 
 
+def verify_artifact(path: str | Path) -> None:
+    """Public digest check for one checkpoint artifact: raises
+    :class:`CheckpointCorruptError` when ``path`` fails its sha256
+    sidecar (a file WITHOUT a sidecar is accepted — pre-checksum
+    layout, or a crash between the data and digest writes). The
+    invariant checker (obsv/invariants.py) audits checkpoint dirs
+    through this so the sidecar contract lives in exactly one place."""
+    _verified_read(Path(path))
+
+
 def _msgpack_restore_checked(data: bytes, path: Path) -> Any:
     try:
         return serialization.msgpack_restore(data)
@@ -468,6 +478,69 @@ def _loadable_steps(train_dir: Path) -> list[int]:
                 train_dir, s).name:
             steps.add(s)
     return sorted(steps)
+
+
+def _digest_tree(tree: Any, h) -> None:
+    """Fold a nested state-dict of arrays into ``h`` canonically:
+    sorted key paths, then dtype/shape/bytes per leaf, every component
+    NUL-delimited so adjacent fields can never be re-split into a
+    colliding byte stream — two trees hash equal iff their structure
+    and arrays are identical."""
+    if isinstance(tree, dict):
+        h.update(b"{\x00")
+        for key in sorted(tree):
+            h.update(str(key).encode() + b"\x00")
+            _digest_tree(tree[key], h)
+        h.update(b"}\x00")
+        return
+    if tree is None:
+        h.update(b"<none>\x00")
+        return
+    a = np.ascontiguousarray(np.asarray(jax.device_get(tree)))
+    h.update(str(a.dtype).encode() + b"\x00")
+    h.update(str(a.shape).encode() + b"\x00")
+    h.update(a.tobytes())
+    h.update(b"\x00")
+
+
+def state_params_digest(state: Any) -> str:
+    """sha256 over the live state's param leaves — the model's bitwise
+    identity, independent of where/when it was saved. The determinism
+    seam the chaos invariant checker compares runs by: a faulted but
+    fully-recovered run must reproduce the fault-free run's digest."""
+    h = hashlib.sha256()
+    _digest_tree(serialization.to_state_dict(state.params), h)
+    return h.hexdigest()
+
+
+def checkpoint_params_digest(train_dir: str | Path,
+                             step: int | None = None
+                             ) -> tuple[str, int] | None:
+    """(sha256-of-params, step) for a saved checkpoint — computed from
+    the ARTIFACT alone (raw state dict, no model template), so the
+    invariant checker can compare two runs' checkpoints without
+    building either model. None when nothing is loadable. Single-file
+    layout only (the local chaos workers are single-process); a
+    sharded checkpoint raises so a silent cross-layout miscompare
+    cannot happen."""
+    train_dir = Path(train_dir)
+    if step is None:
+        step = latest_checkpoint_step(train_dir)
+        if step is None:
+            return None
+    if _manifest_path(train_dir, step).exists():
+        raise NotImplementedError(
+            "params digest over the sharded layout is not supported — "
+            "restore through a template and use state_params_digest")
+    path = _ckpt_path(train_dir, step)
+    payload = _msgpack_restore_checked(_verified_read(path), path)
+    params = (payload.get("state") or {}).get("params")
+    if params is None:
+        raise CheckpointCorruptError(
+            f"{path.name}: payload has no state/params entry")
+    h = hashlib.sha256()
+    _digest_tree(params, h)
+    return h.hexdigest(), step
 
 
 def read_checkpoint_extra(train_dir: str | Path,

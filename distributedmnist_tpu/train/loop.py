@@ -675,6 +675,15 @@ class Trainer:
             "final_step": step,
             "updates_applied": int(jax.device_get(self.state.updates_applied)),
             "last_metrics": final_metrics,
+            # bitwise identity of the final params (train/checkpoint.py
+            # state_params_digest): the chaos invariant checker compares
+            # a faulted-but-recovered run against its fault-free
+            # same-seed reference by this — and against the final
+            # checkpoint's own digest (the two must agree). None when
+            # shards live on other processes (this process cannot
+            # materialize the full params to hash them).
+            "params_digest": (ckpt.state_params_digest(self.state)
+                              if not self._sharded_ckpt else None),
             "timing": self.collector.report(),
             # self-healing outcome: None/0 on a clean run; the CLI maps
             # "preempted" to train.resumable_exit_code

@@ -54,6 +54,23 @@ def test_missing_dir_returns_none(tmp_path):
     assert ckpt.restore_checkpoint(tmp_path / "nope", state) is None
 
 
+def test_params_digest_live_and_file_agree(tmp_path):
+    """The chaos determinism seam: the digest of the live state equals
+    the digest recomputed from the saved artifact alone, and any
+    single-leaf perturbation changes it."""
+    state, _, _ = _state_and_model()
+    live = ckpt.state_params_digest(state)
+    ckpt.save_checkpoint(tmp_path, state, 4)
+    got = ckpt.checkpoint_params_digest(tmp_path)
+    assert got == (live, 4)
+    bumped = state.replace(params=jax.tree.map(
+        lambda p: p + np.asarray(1e-6, p.dtype)
+        if np.issubdtype(np.asarray(p).dtype, np.floating) else p,
+        state.params))
+    assert ckpt.state_params_digest(bumped) != live
+    assert ckpt.checkpoint_params_digest(tmp_path / "nope") is None
+
+
 def test_torn_pointer_falls_back_to_scan(tmp_path):
     state, _, _ = _state_and_model()
     ckpt.save_checkpoint(tmp_path, state, 5)

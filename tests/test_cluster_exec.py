@@ -147,6 +147,18 @@ def test_parse_poll_output_torn_and_empty():
     assert got["step"] == 12 and got["record"]["loss"] == 0.5
 
 
+def test_parse_poll_output_scans_back_past_torn_tail():
+    """A torn final line (the writer mid-append) must not make live
+    progress look stalled for a whole poll tick: the parser scans
+    backwards to the last INTACT record in the tail window."""
+    got = parse_poll_output('{"step": 11, "loss": 0.7}\n'
+                            '{"step": 12, "loss": 0.5}\n'
+                            '{"step": 13, "lo')
+    assert got["step"] == 12 and got["record"]["loss"] == 0.5
+    # nothing intact in the window at all → still -1
+    assert parse_poll_output('garbage\n{"step": 9,')["step"] == -1
+
+
 # ---------------------------------------------------------------------------
 # LocalProcessCluster verbs (each one a real subprocess)
 # ---------------------------------------------------------------------------

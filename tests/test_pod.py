@@ -94,7 +94,7 @@ def test_poll_argv_tails_structured_log():
     argv = m.runner.recorded[0]
     i = argv.index("--worker")
     assert argv[i + 1] == "0"
-    assert "tail -n 1 /tmp/out/train_log.jsonl" in argv[-1]
+    assert "tail -n 3 /tmp/out/train_log.jsonl" in argv[-1]
 
 
 def test_run_until_step_dry_run_sequence():
@@ -103,7 +103,7 @@ def test_run_until_step_dry_run_sequence():
     assert got == {"step": 500, "record": None, "dry_run": True}
     cmds = [a[-1] for a in m.runner.recorded]
     assert "nohup" in cmds[0]          # launch
-    assert "tail -n 1" in cmds[1]      # exactly one poll (no spin)
+    assert "tail -n 3" in cmds[1]      # exactly one poll (no spin)
     assert "pkill" in cmds[2]          # stop at step N
     assert len(cmds) == 3
 
@@ -119,7 +119,7 @@ class _ScriptedRunner(Runner):
     def run(self, argv, check=True, capture=False, **kw):
         self.recorded.append(list(argv))
         cmd = argv[-1]
-        if "tail -n 1" in cmd:
+        if "tail -n 3" in cmd:
             out = self.tails.pop(0) if self.tails else ""
             return type("R", (), {"stdout": out, "returncode": 0})()
         return type("R", (), {"stdout": "", "returncode": 0})()
@@ -134,7 +134,7 @@ def test_wait_until_step_follows_log_and_returns_at_target():
                    _ScriptedRunner(tails))
     got = m.wait_until_step(100, poll_secs=0.0)
     assert got["step"] == 120 and got["record"]["loss"] == 0.2
-    polls = [a for a in m.runner.recorded if "tail -n 1" in a[-1]]
+    polls = [a for a in m.runner.recorded if "tail -n 3" in a[-1]]
     assert len(polls) == 4
 
 
@@ -166,7 +166,7 @@ fi
 case "$*" in
   *" describe "*)  echo '{"state": "READY"}' ;;
   *"pgrep -c"*)    echo 0 ;;
-  *"tail -n 1"*)   cat "${GCLOUD_STUB_POLL:-/dev/null}" 2>/dev/null ;;
+  *"tail -n 3"*)   cat "${GCLOUD_STUB_POLL:-/dev/null}" 2>/dev/null ;;
 esac
 exit 0
 """
@@ -224,7 +224,7 @@ def test_stubbed_gcloud_full_lifecycle_executes(tmp_path, monkeypatch,
     ssh_cmds = [c for c in calls if " ssh " in f" {c} "]
     assert any("nohup" in c for c in ssh_cmds)       # run_train
     assert any("pgrep -c" in c for c in ssh_cmds)    # status probe
-    assert any("tail -n 1" in c for c in ssh_cmds)   # poll
+    assert any("tail -n 3" in c for c in ssh_cmds)   # poll
     s = summarize_journal(m.runner.journal_path)
     assert s["failures"] == 0 and s["commands"] == len(calls)
 

@@ -93,6 +93,49 @@ def test_nan_guard_skips_poisoned_checkpoint(tmp_train_dir,
     assert rb["to_step"] <= 6
 
 
+def test_multi_rollback_log_splices_gap_and_duplicate_free(
+        tmp_train_dir, synthetic_datasets):
+    """Satellite: TWO NaN rollbacks in one run still yield a gap-free,
+    duplicate-free step sequence after rollback splicing — invariant
+    (2) of the chaos checker, driven directly. (Only the
+    single-rollback path was covered before; a second rollback crosses
+    a window that itself contains replayed records.)"""
+    from distributedmnist_tpu.obsv.invariants import (check_metrics_log,
+                                                      splice_rollbacks)
+    from distributedmnist_tpu.obsv.report import load_jsonl
+
+    t = _trainer(tmp_train_dir, synthetic_datasets,
+                 max_steps=16, log_every_steps=2, save_interval_steps=4,
+                 nan_guard_max_rollbacks=3, async_checkpoint=False)
+    poisoned = []
+
+    def cb(step, rec):
+        # first poison detected at the step-8 flush → rollback to 4;
+        # second at step 12 lands right before the cadence save, so the
+        # rollback must also skip the poisoned step-12 checkpoint
+        if step in (6, 12) and step not in poisoned:
+            poisoned.append(step)
+            _poison(t)
+
+    summary = t.run(step_callback=cb)
+    assert summary["final_step"] == 16
+    assert summary["nan_rollbacks"] == 2
+
+    recs = load_jsonl(Path(tmp_train_dir) / "train_log.jsonl", "step")
+    spliced, rewinds = splice_rollbacks(recs)
+    assert rewinds == 2
+    assert [r["step"] for r in spliced] == list(range(1, 17))
+    # the checker agrees: 2 journaled rollbacks explain both rewinds,
+    # the spliced series has no gap and no duplicate
+    assert check_metrics_log(recs, allowed_rewinds=2) == []
+    events = load_recovery_events(Path(tmp_train_dir)
+                                  / "recovery_journal.jsonl")
+    assert sum(e["action"] == "nan_rollback" for e in events) == 2
+    assert all(np.isfinite(json.loads(l)["loss"]) for l in
+               (Path(tmp_train_dir) / "train_log.jsonl")
+               .read_text().splitlines())
+
+
 def test_nan_guard_without_checkpoint_fails_loudly(tmp_train_dir,
                                                    synthetic_datasets):
     t = _trainer(tmp_train_dir, synthetic_datasets,
