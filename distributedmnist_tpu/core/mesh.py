@@ -71,6 +71,30 @@ def shard_map(f, *, mesh, in_specs, out_specs):
                          out_specs=out_specs)
 
 
+def gather_chunks_replicated(chunk, axis_name: str, full_len: int,
+                             offset) -> "jax.Array":
+    """Reassemble per-replica 1-D ``chunk``s (this replica's slice
+    starting at ``offset`` of a ``full_len`` vector) into the FULL
+    vector on every replica — the allgather leg of the ZeRO-1 weight
+    update (parallel/api.py).
+
+    Under the jax-0.4.37 check_rep=False shim this is a plain tiled
+    ``all_gather``. On a replication-checked jax an all_gather result
+    stays marked device-varying and could not leave shard_map under a
+    P() out_spec (the same constraint behind parallel/api.py's
+    ``_gather_replicated`` one-hot psum for the [n] metrics vector) —
+    there, each replica scatters its chunk into a zeros vector and one
+    psum reassembles a statically-replicated result; communication
+    degrades from an allgather to an all-reduce, correctness and the
+    sharded-optimizer-state memory win are unchanged."""
+    if CHECK_REP_SHIM:
+        return jax.lax.all_gather(chunk, axis_name, tiled=True)
+    import jax.numpy as jnp
+    buf = jnp.zeros((full_len,), chunk.dtype)
+    buf = jax.lax.dynamic_update_slice(buf, chunk, (offset,))
+    return jax.lax.psum(buf, axis_name)
+
+
 def initialize_distributed() -> None:
     """Multi-host bring-up (≙ tf.train.Server + startup barrier,
     src/mnist_distributed_train.py:27-35, src/timeout_manager.py:198-211).

@@ -69,11 +69,18 @@ _SHELL_PAYLOAD = ('i=$( [ -f ckpt ] && cat ckpt || echo 0 ); '
 # construction (fixed seed, synthetic data, float32, exact-resume
 # checkpoints), so a fully recovered trial must reproduce the
 # reference bitwise. {max_steps}/{save} templated from the config.
+# Runs a 2-replica simulated mesh with momentum and the ZeRO-1 sharded
+# weight update ON, so every campaign exercises replica-sharded
+# optimizer state end-to-end — kill/corrupt/resume must round-trip the
+# canonical checkpoint layout exactly, and invariant 3's opt-state
+# digest covers it instead of reporting vacuously on a stateless SGD.
 _TRAIN_PAYLOAD = (
     "python -m distributedmnist_tpu.launch train "
     "train.train_dir=. data.dataset=synthetic data.batch_size=32 "
     "data.synthetic_train_size=256 data.synthetic_test_size=64 "
-    "model.compute_dtype=float32 train.max_steps={max_steps} "
+    "model.compute_dtype=float32 mesh.simulate_devices=2 "
+    "optim.momentum=0.9 parallel.shard_weight_update=true "
+    "train.max_steps={max_steps} "
     "train.log_every_steps=1 train.save_interval_steps={save} "
     "train.async_checkpoint=false train.save_results_period=0")
 
@@ -378,6 +385,22 @@ class ChaosCampaign:
             json.dumps(outcome, indent=2, default=str))
         return outcome
 
+    @staticmethod
+    def _logged_since_spawn(worker: dict) -> bool:
+        """Has this worker appended to its own train_log.jsonl since
+        its CURRENT incarnation spawned? False means it is still
+        booting (a restarted jax worker spends ~15-30 s before its
+        first log line). Unknown spawn time (pre-``spawned_at`` state
+        files) reads as True — the legacy behavior."""
+        spawned = worker.get("spawned_at")
+        if spawned is None:
+            return True
+        log = Path(worker["logdir"]) / "train_log.jsonl"
+        try:
+            return log.stat().st_mtime >= spawned
+        except OSError:
+            return False  # no log at all yet: definitely still booting
+
     def _drain(self, cluster: LocalProcessCluster) -> None:
         """The supervisor returns when the FASTEST worker hits the
         target; wait for the rest to finish their final save and exit
@@ -387,22 +410,41 @@ class ChaosCampaign:
         worker whose log stops moving for a whole stall window (a
         permanently SIGSTOPped straggler past its restart budget —
         alive to kill -0 forever) is given up on early rather than
-        riding out the full drain timeout."""
+        riding out the full drain timeout.
+
+        The stall clock is PER WORKER and does not start until that
+        worker has logged at least one line since its own (re)spawn: a
+        worker restarted near the end of the run spends a full jax boot
+        (> drain_stall_s) producing no log movement, and the old global
+        clock would kill it mid-boot — silently downgrading the trial
+        to determinism-skipped (PR 4's known rough edge). A worker that
+        never logs at all is still bounded by drain_timeout_s."""
         deadline = time.monotonic() + self.cfg.drain_timeout_s
         stall_window = self.cfg.drain_stall_s
-        last_progress: dict[int, int] = {}
-        moved_at = time.monotonic()
+        last_progress: dict[int, Any] = {}
+        moved_at: dict[int, float] = {}
         while time.monotonic() < deadline:
             st = cluster.status()
             if st is None or not any(w["alive"] for w in st["workers"]):
                 return
+            now = time.monotonic()
             prog = cluster.worker_progress()
-            if prog != last_progress:
-                last_progress = prog
-                moved_at = time.monotonic()
-            elif time.monotonic() - moved_at >= stall_window:
-                logger.warning("drain: no log movement for %.0fs with "
-                               "workers still alive — giving up early",
+            stalled: list[bool] = []
+            for w in st["workers"]:
+                if not w["alive"]:
+                    continue
+                k = w["worker"]
+                if k not in moved_at or prog.get(k) != last_progress.get(k):
+                    last_progress[k] = prog.get(k)
+                    moved_at[k] = now
+                if not self._logged_since_spawn(w):
+                    moved_at[k] = now  # booting: hold its clock at zero
+                    stalled.append(False)
+                else:
+                    stalled.append(now - moved_at[k] >= stall_window)
+            if stalled and all(stalled):
+                logger.warning("drain: no log movement for %.0fs on any "
+                               "live worker — giving up early",
                                stall_window)
                 return
             time.sleep(self.cfg.resolved_poll_secs())

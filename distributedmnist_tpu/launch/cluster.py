@@ -458,6 +458,11 @@ class LocalProcessCluster(ClusterBackend):
         finally:
             log_fh.close()  # the child holds its own descriptor
         w["pid"] = proc.pid
+        # epoch timestamp of THIS incarnation's spawn: lets consumers
+        # (the chaos drain) tell "hasn't logged since its restart —
+        # still booting" from "logged, then stalled" by comparing the
+        # worker's train_log.jsonl mtime against it
+        w["spawned_at"] = time.time()
         self.exec.journal({"event": "spawn", "worker": k, "pid": proc.pid,
                            "command": self.cfg.train_command})
 
@@ -549,7 +554,8 @@ class LocalProcessCluster(ClusterBackend):
             # a retrying executor must not burn its budget observing it
             alive = bool(w.get("pid")) and self._pid_alive(w["pid"])
             workers.append({"worker": w["worker"], "pid": w.get("pid"),
-                            "alive": alive, "logdir": w["logdir"]})
+                            "alive": alive, "logdir": w["logdir"],
+                            "spawned_at": w.get("spawned_at")})
         return {"state": state["phase"].upper(),
                 "workers": workers,
                 "idle": not any(w["alive"] for w in workers)}

@@ -16,8 +16,14 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec
 
 from ..core.config import ModelConfig
+
+# NOTE: rule tables reference parallel/partition_rules.py only by
+# convention (they receive its RuleAxes and return its (regex, spec)
+# Rule pairs) — importing it here would cycle through parallel/__init__
+# → parallel.api → models.registry.
 
 
 def classification_eval_metrics(logits: jax.Array, labels: jax.Array,
@@ -99,6 +105,15 @@ class Model:
     pp_1f1b_grads_factory: Callable[..., Callable[..., tuple]] | None = None
     pp_1f1b_apply_factory: (Callable[..., Callable[..., jax.Array]]
                             | None) = None
+    # Declarative parameter-placement rules (parallel/partition_rules):
+    # partition_rules(axes: RuleAxes) -> ordered [(path-regex,
+    # PartitionSpec)] list covering EVERY param leaf for whatever mix
+    # of tp/pp/ep axes is active (inactive axes arrive as None and the
+    # table leaves those dims unsharded). This is the single source the
+    # spec engine maps over the real param tree — the per-shape
+    # tp_param_specs/pp_param_specs builders above remain the models'
+    # hand-built originals and the parity oracle for the tables.
+    partition_rules: Callable[..., list] | None = None
     # Auxiliary loss (MoE load balancing): when True, ``apply`` and the
     # sharded applies accept ``return_aux=True`` and return
     # (logits, aux); the train step adds ``aux_weight * aux``.
@@ -109,6 +124,65 @@ class Model:
     # they refuse such a model rather than silently training without
     # dropout.
     uses_dropout: bool = False
+
+
+# ---------------------------------------------------------------------------
+# Default partition-rule tables (the per-model regex→PartitionSpec
+# tables the spec engine maps over real param trees; see
+# parallel/partition_rules.match_partition_rules)
+# ---------------------------------------------------------------------------
+
+def replicated_partition_rules(axes) -> list:
+    """Every leaf replicated — the table for models with no
+    tensor/pipeline/expert parallelism support (cnn, resnet)."""
+    del axes
+    return [(r".*", PartitionSpec())]
+
+
+def transformer_partition_rules(num_experts: int):
+    """The transformer's table, parameterized like its hand-built spec
+    functions: Megatron column/row TP on the model axis, experts on the
+    expert axis, and — when ``axes.stage`` is set — the stacked
+    (pipeline) layout whose block leaves carry a leading layer dim
+    sharded over the stage axis. Flat-layout block paths look like
+    ``blocks/3/wqkv``; stacked ones like ``blocks/wqkv`` — distinct
+    regexes, so one call's table is unambiguous either way."""
+    def rules(axes) -> list:
+        P = PartitionSpec
+        m, e, s = axes.model, axes.expert, axes.stage
+        out: list = []
+        if s is not None:
+            # stacked layout: leading layer dim over the stage axis
+            out += [
+                (r"blocks/wqkv$", P(s, None, None, m)),
+                (r"blocks/wo$", P(s, m, None)),
+                (r"blocks/(ln1|ln2)/scale$", P(s)),
+            ]
+            if num_experts > 0:
+                out += [(r"blocks/router$", P(s)),
+                        (r"blocks/w1$", P(s, e, None, m)),
+                        (r"blocks/w2$", P(s, e, m, None))]
+            else:
+                out += [(r"blocks/w1$", P(s, None, m)),
+                        (r"blocks/w2$", P(s, m, None))]
+        else:
+            out += [
+                (r"blocks/\d+/wqkv$", P(None, None, m)),
+                (r"blocks/\d+/wo$", P(m, None)),
+            ]
+            if num_experts > 0:
+                out += [(r"blocks/\d+/router$", P()),
+                        (r"blocks/\d+/w1$", P(e, None, m)),
+                        (r"blocks/\d+/w2$", P(e, m, None))]
+            else:
+                out += [(r"blocks/\d+/w1$", P(None, m)),
+                        (r"blocks/\d+/w2$", P(m, None))]
+        # embeddings and norms replicated in every layout (stacked block
+        # norms matched above first — first match wins)
+        out += [(r"(^|/)(ln1|ln2|final_norm)/scale$", P()),
+                (r"^(embed|pos)$", P())]
+        return out
+    return rules
 
 
 _REGISTRY: dict[str, Callable[[ModelConfig], Model]] = {}
@@ -149,6 +223,7 @@ def _mnist_cnn(cfg: ModelConfig) -> Model:
     return Model(name=cfg.name, init=init, apply=apply,
                  loss=cnn.loss_fn, accuracy=cnn.accuracy,
                  input_shape=(cfg.image_size, cfg.image_size, cfg.num_channels),
+                 partition_rules=replicated_partition_rules,
                  uses_dropout=cfg.dropout_rate > 0.0)
 
 
@@ -168,7 +243,8 @@ def _resnet20(cfg: ModelConfig) -> Model:
     from . import cnn
     return Model(name=cfg.name, init=init, apply=apply,
                  loss=cnn.loss_fn, accuracy=cnn.accuracy,
-                 input_shape=(cfg.image_size, cfg.image_size, cfg.num_channels))
+                 input_shape=(cfg.image_size, cfg.image_size, cfg.num_channels),
+                 partition_rules=replicated_partition_rules)
 
 
 @register("transformer")
@@ -393,6 +469,7 @@ def _transformer(cfg: ModelConfig) -> Model:
                  input_shape=(cfg.seq_len,), input_dtype=jnp.int32,
                  eval_metrics=lm_eval_metrics,
                  sharded_apply_factory=sharded_apply_factory,
+                 partition_rules=transformer_partition_rules(cfg.num_experts),
                  has_aux=moe, aux_weight=cfg.moe_aux_weight,
                  tp_param_specs=lambda axis, expert_axis=None:
                      transformer.param_partition_specs(

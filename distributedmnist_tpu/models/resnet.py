@@ -19,6 +19,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .cnn import truncated_normal_init
@@ -30,10 +31,13 @@ BLOCKS_PER_STAGE = 3  # 3 stages × 3 blocks × 2 convs + stem + head = 20 layer
 
 
 def _conv_init(key: jax.Array, shape: tuple[int, ...]) -> jax.Array:
-    # He-style fan-out scaling, truncated
+    # He-style fan-out scaling, truncated. The stddev is computed on
+    # host (float32, same IEEE sqrt the old jnp scalar produced
+    # bit-for-bit) so init is traceable under jax.eval_shape — the
+    # partition-rule engine maps rules over abstract param shapes.
     fan_out = shape[0] * shape[1] * shape[3]
-    stddev = jnp.sqrt(2.0 / fan_out)
-    return truncated_normal_init(key, shape, stddev=float(stddev))
+    stddev = float(np.sqrt(np.float32(2.0 / fan_out)))
+    return truncated_normal_init(key, shape, stddev=stddev)
 
 
 def init(key: jax.Array, num_classes: int = 10, num_channels: int = 3) -> Params:

@@ -17,8 +17,10 @@ survived fault schedule must satisfy:
    by a journaled recovery event (an unexplained duplicate record is
    exactly how a buggy rollback would corrupt every downstream report).
 3. **determinism** — a faulted-but-fully-recovered worker's final
-   params are BITWISE equal to a fault-free same-seed reference run's
-   (``train/checkpoint.py`` params digests).
+   params AND optimizer state are BITWISE equal to a fault-free
+   same-seed reference run's (``train/checkpoint.py`` params + opt
+   digests; the canonical-layout save contract makes the opt-state
+   half meaningful even for ZeRO-1 replica-sharded momentum).
 4. **causality** — every ``restart`` is preceded by a ``detect``,
    every ``fallback_restore`` by a corruption/IO event: recovery
    actions without recorded causes mean the journal lies.
@@ -266,10 +268,16 @@ def check_checkpoint_dir(logdir: str | Path, exempt: set[str] = frozenset(),
 
 def determinism_verdict(logdir: str | Path, reference_dir: str | Path,
                         worker: int | None = None,
-                        reference_digest: tuple[str, int] | None = None
+                        reference_digest: tuple[str, int] | None = None,
+                        reference_opt_digest: tuple[str, int] | None = None,
                         ) -> tuple[bool, list[Violation]]:
-    """Invariant (3): the worker's final checkpoint params must be
-    BITWISE equal to the fault-free same-seed reference run's.
+    """Invariant (3): the worker's final checkpoint params AND
+    optimizer state must be BITWISE equal to the fault-free same-seed
+    reference run's. The opt-state half compares the artifact's
+    canonical-layout ``momentum`` subtree (train/checkpoint.py
+    ``checkpoint_opt_state_digest``) — covered, not skipped, when the
+    run sharded its weight update (ZeRO-1), because checkpoints always
+    store the logical layout.
 
     Returns ``(checked, violations)``. The comparison only applies to a
     FULLY recovered worker — one whose latest loadable checkpoint
@@ -278,10 +286,14 @@ def determinism_verdict(logdir: str | Path, reference_dir: str | Path,
     tore and nothing ever re-saved) yields ``checked=False`` rather
     than a comparison against a further-along reference."""
     from ..train.checkpoint import (CheckpointCorruptError,
-                                    checkpoint_params_digest)
+                                    checkpoint_state_digests)
     try:
-        ref = (reference_digest if reference_digest is not None
-               else checkpoint_params_digest(reference_dir))
+        if reference_digest is not None:
+            ref, ref_opt = reference_digest, reference_opt_digest
+        else:
+            both = checkpoint_state_digests(reference_dir)
+            ref, ref_opt = ((None, None) if both is None else
+                            ((both[0], both[2]), (both[1], both[2])))
     except CheckpointCorruptError as e:
         return True, [Violation(
             "determinism", f"reference checkpoint unreadable: {e}", worker)]
@@ -290,18 +302,26 @@ def determinism_verdict(logdir: str | Path, reference_dir: str | Path,
         # there is no bitwise claim to make — skipped, not violated
         return False, []
     try:
-        got = checkpoint_params_digest(logdir)
+        both = checkpoint_state_digests(logdir)  # ONE artifact read
     except CheckpointCorruptError:
         return False, []  # torn latest, never re-saved: not recovered
-    if got is None or got[1] != ref[1]:
+    if both is None or both[2] != ref[1]:
         return False, []  # never reached the reference step
-    if got[0] != ref[0]:
-        return True, [Violation(
+    got_params, got_opt, at_step = both
+    out: list[Violation] = []
+    if got_params != ref[0]:
+        out.append(Violation(
             "determinism",
-            f"final params at step {got[1]} differ bitwise from the "
-            f"fault-free reference ({got[0][:12]}… != {ref[0][:12]}…)",
-            worker)]
-    return True, []
+            f"final params at step {at_step} differ bitwise from the "
+            f"fault-free reference ({got_params[:12]}… != {ref[0][:12]}…)",
+            worker))
+    if ref_opt is not None and got_opt != ref_opt[0]:
+        out.append(Violation(
+            "determinism",
+            f"optimizer state at step {at_step} differs bitwise from "
+            f"the fault-free reference ({got_opt[:12]}… != "
+            f"{ref_opt[0][:12]}…)", worker))
+    return True, out
 
 
 # ---------------------------------------------------------------------------
@@ -359,11 +379,15 @@ def check_run(trial_dir: str | Path, outcome: dict | None = None,
     # the reference checkpoint is immutable once its run completed:
     # digest it ONCE per check, not once per worker
     ref_digest: tuple[str, int] | None = None
+    ref_opt_digest: tuple[str, int] | None = None
     if reference_dir is not None:
         from ..train.checkpoint import (CheckpointCorruptError,
-                                        checkpoint_params_digest)
+                                        checkpoint_state_digests)
         try:
-            ref_digest = checkpoint_params_digest(reference_dir)
+            both = checkpoint_state_digests(reference_dir)
+            if both is not None:
+                ref_digest = (both[0], both[2])
+                ref_opt_digest = (both[1], both[2])
         except CheckpointCorruptError as e:
             violations.append(Violation(
                 "determinism", f"reference checkpoint unreadable: {e}"))
@@ -396,7 +420,8 @@ def check_run(trial_dir: str | Path, outcome: dict | None = None,
         violations += check_checkpoint_dir(d, exempt.get(k, set()), worker=k)
         if reference_dir is not None:
             checked, det_violations = determinism_verdict(
-                d, reference_dir, worker=k, reference_digest=ref_digest)
+                d, reference_dir, worker=k, reference_digest=ref_digest,
+                reference_opt_digest=ref_opt_digest)
             violations += det_violations
             det_checked += checked
     if reference_dir is None:

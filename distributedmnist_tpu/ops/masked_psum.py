@@ -28,6 +28,19 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def contribution_scale(flag: jax.Array,
+                       axis_name: str) -> tuple[jax.Array, jax.Array]:
+    """(scale, num_contributors): pre-multiplying each replica's
+    contribution by ``scale = flag / max(psum(flag), 1)`` makes any
+    subsequent cross-replica SUM the masked mean directly — one
+    elementwise pass, shared by the all-reduce path below and the
+    ZeRO-1 reduce-scatter path (parallel/api.py), so the two
+    disciplines cannot drift in masking semantics."""
+    flag = flag.astype(jnp.float32)
+    num = lax.psum(flag, axis_name)
+    return flag / jnp.maximum(num, 1.0), num
+
+
 def masked_mean_psum(tree: Any, flag: jax.Array, axis_name: str) -> tuple[Any, jax.Array]:
     """Cross-replica masked mean of a pytree.
 
@@ -43,13 +56,11 @@ def masked_mean_psum(tree: Any, flag: jax.Array, axis_name: str) -> tuple[Any, j
       is all-zeros (the update becomes a no-op, mirroring a PS step with
       an empty accumulator never firing).
     """
-    flag = flag.astype(jnp.float32)
-    num = lax.psum(flag, axis_name)
     # One elementwise pass per leaf: pre-scale by the SCALAR flag/denom
     # so psum produces the mean directly (scaling after the psum would
     # spend a second full-size HBM pass per leaf — measured as a real
     # throughput tax on small step times by bench_mode_overhead).
-    scale = flag / jnp.maximum(num, 1.0)
+    scale, num = contribution_scale(flag, axis_name)
     mean = jax.tree.map(
         lambda g: lax.psum(g * scale.astype(g.dtype), axis_name), tree)
     return mean, num

@@ -18,6 +18,7 @@ donated state; everything inside is static-shaped and control flow is
 from __future__ import annotations
 
 import dataclasses
+import functools
 from functools import partial
 from typing import Any, Callable
 
@@ -30,11 +31,17 @@ from jax.sharding import PartitionSpec as P
 from ..core import mesh as mesh_lib
 from ..core import prng
 from ..core.config import ExperimentConfig
+from ..core.log import get_logger
 from ..core.mesh import Topology
-from ..models.registry import Model
+from ..models.registry import Model, replicated_partition_rules
 from ..ops.drop_connect import drop_connect_grads
-from ..ops.masked_psum import masked_mean_psum
+from ..ops.masked_psum import contribution_scale, masked_mean_psum
 from . import policies
+from .partition_rules import (RuleAxes, Zero1Plan, match_partition_rules,
+                              make_zero1_plan, zero1_init_state, zero1_pack,
+                              zero1_state_specs, zero1_unpack)
+
+logger = get_logger("parallel")
 
 # LR schedule: updates_applied -> lr (see train.lr_schedule; kept as a
 # plain callable type here to avoid a parallel<->train import cycle).
@@ -61,49 +68,11 @@ class TrainState(struct.PyTreeNode):
     next_apply_ms: jax.Array
 
 
-def state_partition_specs(model: Model, cfg: ExperimentConfig,
-                          topo: Topology) -> TrainState:
-    """A TrainState-shaped pytree of PartitionSpecs: P() (replicated)
-    everywhere, except param-shaped subtrees which take the model's
-    tensor-parallel specs when the mesh's model axis is >1."""
-    from jax.sharding import PartitionSpec as P_
-
-    n_model = topo.mesh.shape[topo.model_axis]
-    n_stage = topo.mesh.shape[topo.stage_axis]
-    n_expert = topo.mesh.shape[topo.expert_axis]
-    if n_model > 1 and getattr(model, "tp_param_specs", None) is None:
-        raise ValueError(f"mesh has model_parallelism={n_model} but model "
-                         f"{model.name!r} has no tensor-parallel parameter "
-                         "specs")
-    if n_expert > 1 and (getattr(model, "tp_param_specs", None) is None
-                         or not getattr(model, "has_aux", False)):
-        raise ValueError(f"mesh has expert_parallelism={n_expert} but model "
-                         f"{model.name!r} has no experts to shard")
-    if n_stage > 1 and getattr(model, "pp_param_specs", None) is None:
-        raise ValueError(f"mesh has pipeline_parallelism={n_stage} but model "
-                         f"{model.name!r} has no pipeline parameter specs")
-    if n_stage > 1:
-        pspec: Any = model.pp_param_specs(
-            topo.stage_axis, topo.model_axis if n_model > 1 else None,
-            topo.expert_axis if n_expert > 1 else None)
-    elif n_model > 1 or n_expert > 1:
-        pspec = model.tp_param_specs(
-            topo.model_axis if n_model > 1 else None,
-            topo.expert_axis if n_expert > 1 else None)
-    else:
-        pspec = P_()
-    has_momentum = cfg.optim.momentum > 0.0
-    interval = cfg.sync.mode == "interval"
-    return TrainState(
-        params=pspec,
-        momentum=pspec if has_momentum else None,
-        step=P_(), updates_applied=P_(), root_key=P_(),
-        window_acc=pspec if interval else None,
-        window_rounds=P_(), wall_ms=P_(), next_apply_ms=P_())
-
-
-def init_train_state(model: Model, cfg: ExperimentConfig,
-                     topo: Topology | None = None) -> TrainState:
+def _build_params(model: Model, cfg: ExperimentConfig,
+                  topo: Topology | None) -> Any:
+    """Init params in the layout the mesh trains (pp-transformed when
+    the stage axis is active) — shared by :func:`init_train_state` and
+    the abstract-shape path the spec engine maps rules over."""
     params = model.init(jax.random.PRNGKey(cfg.model.init_seed))
     if (topo is not None and topo.mesh.shape[topo.stage_axis] > 1):
         if getattr(model, "pp_transform", None) is None:
@@ -121,8 +90,131 @@ def init_train_state(model: Model, cfg: ExperimentConfig,
                 cfg.mesh.pipeline_chunks)
         else:
             params = model.pp_transform(params)  # layer-stacked layout
-    momentum = (jax.tree.map(jnp.zeros_like, params)
-                if cfg.optim.momentum > 0.0 else None)
+    return params
+
+
+@functools.lru_cache(maxsize=128)
+def _abstract_train_params_cached(model: Model, cfg: ExperimentConfig,
+                                  topo: Topology | None) -> Any:
+    return jax.eval_shape(lambda: _build_params(model, cfg, topo))
+
+
+def abstract_train_params(model: Model, cfg: ExperimentConfig,
+                          topo: Topology | None) -> Any:
+    """Shape/dtype skeleton of the trained param tree (no FLOPs, no
+    device buffers) — what the rule engine needs to name leaves.
+
+    Memoized on the (model, cfg, topo) triple: one Trainer build calls
+    through here several times (state specs, train step, eval step,
+    ZeRO-1 plan) with the same frozen objects, and re-tracing init each
+    time is pure waste (~0.1 s per trace). Falls back to a direct trace
+    for unhashable inputs."""
+    try:
+        return _abstract_train_params_cached(model, cfg, topo)
+    except TypeError:
+        return jax.eval_shape(lambda: _build_params(model, cfg, topo))
+
+
+def params_partition_specs(model: Model, cfg: ExperimentConfig,
+                           topo: Topology, params: Any = None) -> Any:
+    """The per-leaf PartitionSpec tree for the trained params, derived
+    by mapping the model's declarative rule table
+    (``models/registry.py``) over the real param tree with the active
+    mesh axes bound — ``parallel/partition_rules.py``. Replaces the
+    hand-built spec trees ``state_partition_specs`` used to assemble
+    per layout; the models' spec builders remain as the parity oracle
+    (tests/test_partition_rules.py)."""
+    n_model = topo.mesh.shape[topo.model_axis]
+    n_stage = topo.mesh.shape[topo.stage_axis]
+    n_expert = topo.mesh.shape[topo.expert_axis]
+    if n_model > 1 and getattr(model, "tp_param_specs", None) is None:
+        raise ValueError(f"mesh has model_parallelism={n_model} but model "
+                         f"{model.name!r} has no tensor-parallel parameter "
+                         "specs")
+    if n_expert > 1 and (getattr(model, "tp_param_specs", None) is None
+                         or not getattr(model, "has_aux", False)):
+        raise ValueError(f"mesh has expert_parallelism={n_expert} but model "
+                         f"{model.name!r} has no experts to shard")
+    if n_stage > 1 and getattr(model, "pp_param_specs", None) is None:
+        raise ValueError(f"mesh has pipeline_parallelism={n_stage} but model "
+                         f"{model.name!r} has no pipeline parameter specs")
+    axes = RuleAxes(
+        model=topo.model_axis if n_model > 1 else None,
+        expert=topo.expert_axis if n_expert > 1 else None,
+        stage=topo.stage_axis if n_stage > 1 else None)
+    if model.partition_rules is None and (axes.model or axes.expert
+                                          or axes.stage):
+        # the replicated fallback table is only safe when nothing needs
+        # sharding — silently replicating a TP/PP/EP model's weights
+        # would double-count its model-axis psums, the exact failure
+        # the rule engine's unmatched-leaf error exists to prevent
+        raise ValueError(
+            f"model {model.name!r} declares sharded-parallelism support "
+            "but no partition_rules table (models/registry.py) — cannot "
+            f"derive placements for active axes {axes}")
+    rules = (model.partition_rules or replicated_partition_rules)(axes)
+    if params is None:
+        params = abstract_train_params(model, cfg, topo)
+    return match_partition_rules(rules, params)
+
+
+def zero1_plan_for(model: Model, cfg: ExperimentConfig, topo: Topology,
+                   params: Any = None) -> Zero1Plan | None:
+    """The ZeRO-1 shard plan when ``parallel.shard_weight_update`` is
+    both enabled and applicable, else None. Inapplicable: a replica
+    axis of 1 (nothing is redundant), or interval mode (the windowed
+    accumulator averages the FULL mean across steps; sharding it too is
+    possible but not worth the extra state surface — documented
+    fallback, see README Performance)."""
+    par = cfg.parallel
+    if not par.shard_weight_update:
+        return None
+    if topo.num_replicas <= 1 or cfg.sync.mode == "interval":
+        return None
+    if params is None:
+        params = abstract_train_params(model, cfg, topo)
+    pspecs = params_partition_specs(model, cfg, topo, params=params)
+    return make_zero1_plan(params, pspecs, topo.replica_axis,
+                           topo.num_replicas,
+                           min_leaf_size=par.shard_min_leaf_size)
+
+
+def state_partition_specs(model: Model, cfg: ExperimentConfig,
+                          topo: Topology) -> TrainState:
+    """A TrainState-shaped pytree of PartitionSpecs: P() (replicated)
+    scalars, per-leaf engine-derived specs for param-shaped subtrees
+    (tensor/pipeline/expert placements per the model's rule table), and
+    — under ``parallel.shard_weight_update`` — momentum buffers split
+    over the replica axis per the ZeRO-1 plan."""
+    from jax.sharding import PartitionSpec as P_
+
+    abstract = abstract_train_params(model, cfg, topo)
+    pspec = params_partition_specs(model, cfg, topo, params=abstract)
+    has_momentum = cfg.optim.momentum > 0.0
+    interval = cfg.sync.mode == "interval"
+    plan = zero1_plan_for(model, cfg, topo, params=abstract)
+    mspec = None
+    if has_momentum:
+        mspec = (zero1_state_specs(plan, pspec) if plan is not None
+                 else pspec)
+    return TrainState(
+        params=pspec,
+        momentum=mspec,
+        step=P_(), updates_applied=P_(), root_key=P_(),
+        window_acc=pspec if interval else None,
+        window_rounds=P_(), wall_ms=P_(), next_apply_ms=P_())
+
+
+def init_train_state(model: Model, cfg: ExperimentConfig,
+                     topo: Topology | None = None) -> TrainState:
+    params = _build_params(model, cfg, topo)
+    plan = (zero1_plan_for(model, cfg, topo, params=params)
+            if topo is not None else None)
+    if cfg.optim.momentum > 0.0:
+        momentum = (zero1_init_state(params, plan) if plan is not None
+                    else jax.tree.map(jnp.zeros_like, params))
+    else:
+        momentum = None
     interval = cfg.sync.mode == "interval"
     return TrainState(
         params=params,
@@ -137,6 +229,34 @@ def init_train_state(model: Model, cfg: ExperimentConfig,
     )
 
 
+# ---------------------------------------------------------------------------
+# canonical checkpoint layout (ZeRO-1 pack/unpack)
+# ---------------------------------------------------------------------------
+
+def canonical_save_state(state: TrainState,
+                         plan: Zero1Plan | None) -> TrainState:
+    """The state as checkpoints store it: optimizer buffers in their
+    LOGICAL shapes regardless of the in-memory ZeRO-1 layout, so the
+    artifact (and its canonical path digest, train/checkpoint.py) is
+    byte-stable across ``parallel.shard_weight_update`` settings and a
+    sharded run's checkpoint restores onto a replicated config (and
+    vice versa) with no migration. Host-side; a no-op without a plan."""
+    if plan is None or state.momentum is None:
+        return state
+    return state.replace(momentum=zero1_unpack(state.momentum, plan))
+
+
+def pack_restored_state(state: TrainState,
+                        plan: Zero1Plan | None) -> TrainState:
+    """Inverse of :func:`canonical_save_state` on the restore path:
+    fold canonically-saved (logical-shape) momentum back into the
+    flattened-padded replica-shard layout the live state uses. Exact —
+    padding is zeros, truncation only ever removes padding."""
+    if plan is None or state.momentum is None:
+        return state
+    return state.replace(momentum=zero1_pack(state.momentum, plan))
+
+
 def _sgd(params: Any, grads: Any, momentum_bufs: Any, lr: jax.Array,
          momentum: float) -> tuple[Any, Any]:
     """Plain SGD (≙ tf.train.GradientDescentOptimizer,
@@ -147,6 +267,90 @@ def _sgd(params: Any, grads: Any, momentum_bufs: Any, lr: jax.Array,
     new_bufs = jax.tree.map(lambda b, g: momentum * b + g, momentum_bufs, grads)
     new_params = jax.tree.map(lambda p, b: p - lr * b, params, new_bufs)
     return new_params, new_bufs
+
+
+def _pad_flat(x: jax.Array, lp) -> jax.Array:
+    """Flatten a logical leaf and zero-pad it to the plan's ``pad``
+    length (the even-split layout; padding math lives in the engine,
+    partition_rules.LeafShardPlan)."""
+    flat = x.reshape(-1)
+    if lp.pad == lp.size:
+        return flat
+    return jnp.concatenate(
+        [flat, jnp.zeros((lp.pad - lp.size,), flat.dtype)])
+
+
+def _zero1_update(params: Any, grads: Any, momentum_bufs: Any,
+                  flag: jax.Array, lr: jax.Array, momentum: float,
+                  axis: str, plan: Zero1Plan
+                  ) -> tuple[Any, Any, jax.Array, jax.Array]:
+    """The ZeRO-1 weight-update discipline (arXiv:2004.13336), inside
+    shard_map: per sharded leaf, the masked gradients are
+    REDUCE-SCATTERED over the replica axis (each replica receives the
+    summed 1/n slice — the full mean gradient is never materialized),
+    the optimizer state and param slice are updated locally, and the
+    fresh param slices are allgathered back to the replicated layout
+    the forward pass consumes. Fallback leaves (tensor-parallel
+    placements, leaves below the shard floor) take the classic
+    replicated psum + full update.
+
+    Masking semantics match the replicated path exactly: gradients are
+    pre-scaled by ``flag / max(psum(flag), 1)`` so the scattered sum IS
+    the masked mean, and an all-masked step is a true no-op (plain SGD
+    scales lr by the applied flag; momentum decay is select-guarded).
+
+    Returns ``(new_params, new_bufs, num_contributors, applied)``.
+    """
+    scale, num = contribution_scale(flag, axis)
+    applied = (num > 0).astype(jnp.int32)
+    me = lax.axis_index(axis)
+
+    p_leaves, treedef = jax.tree.flatten(params)
+    g_leaves = treedef.flatten_up_to(grads)
+    lp_leaves = treedef.flatten_up_to(plan.leaf_plans)
+    b_leaves = (treedef.flatten_up_to(momentum_bufs)
+                if momentum_bufs is not None else [None] * len(p_leaves))
+    # plain SGD: lr·0 is exact, so scaling lr by the applied flag IS
+    # the all-masked no-op (same trick as the replicated path)
+    lr_plain = lr * applied.astype(jnp.float32)
+
+    new_p, new_b = [], []
+    for p, g, b, lp in zip(p_leaves, g_leaves, b_leaves, lp_leaves):
+        gm = g * scale.astype(g.dtype)
+        if lp.sharded:
+            # reduce-scatter: [pad] masked grads → this replica's
+            # summed [chunk] slice (already the mean via the pre-scale)
+            gsh = lax.psum_scatter(_pad_flat(gm, lp), axis,
+                                   scatter_dimension=0, tiled=True)
+            psh = lax.dynamic_slice(_pad_flat(p, lp), (me * lp.chunk,),
+                                    (lp.chunk,))
+            if b is None:
+                nps, nbs = psh - lr_plain * gsh, None
+            else:
+                nbs = momentum * b + gsh
+                nps = psh - lr * nbs
+                # momentum decays even on zero grads: true no-op needs
+                # the select (chunk-sized — 1/n of the replicated cost)
+                nps = jnp.where(applied > 0, nps, psh)
+                nbs = jnp.where(applied > 0, nbs, b)
+            full = mesh_lib.gather_chunks_replicated(
+                nps, axis, lp.pad, me * lp.chunk)
+            new_p.append(full[:lp.size].reshape(lp.shape))
+            new_b.append(nbs)
+        else:
+            mean = lax.psum(gm, axis)
+            if b is None:
+                new_p.append(p - lr_plain * mean)
+                new_b.append(None)
+            else:
+                nb = momentum * b + mean
+                npv = p - lr * nb
+                new_p.append(jnp.where(applied > 0, npv, p))
+                new_b.append(jnp.where(applied > 0, nb, b))
+    params_out = jax.tree.unflatten(treedef, new_p)
+    bufs_out = (jax.tree.unflatten(treedef, new_b)
+                if momentum_bufs is not None else None)
+    return params_out, bufs_out, num, applied
 
 
 def _gather_replicated(x: jax.Array, axis: str, n: int) -> jax.Array:
@@ -279,6 +483,17 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
     # already device-varying there)
     grad_axes = (axis, seq_ax) if n_seq > 1 else (axis,)
     state_specs = state_partition_specs(model, cfg, topo)
+    # ZeRO-1 (parallel.shard_weight_update): reduce-scatter grads,
+    # update only this replica's param/momentum slice, allgather fresh
+    # params — per the engine's shard plan, which state_partition_specs
+    # and init_train_state derived the state layout from.
+    z_plan = zero1_plan_for(model, cfg, topo)
+    if cfg.parallel.shard_weight_update and z_plan is None:
+        logger.warning(
+            "parallel.shard_weight_update=true is a no-op here (%s); "
+            "running the replicated update",
+            "replica axis is 1" if n <= 1 else
+            f"sync.mode={mode!r} keeps the full windowed accumulator")
 
     has_aux = getattr(model, "has_aux", False)
     aux_w = getattr(model, "aux_weight", 0.0)
@@ -415,12 +630,23 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         else:  # interval: stale if slower than a whole window
             flag = policies.timeout_flag(t_ms, sync.interval_ms)
 
-        mean_grads, num_contrib = masked_mean_psum(grads, flag, axis)
-
         # --- apply discipline ----------------------------------------
         if mode == "interval":
+            mean_grads, num_contrib = masked_mean_psum(grads, flag, axis)
             new_state, applied = _interval_apply(state, mean_grads, t_ms)
+        elif z_plan is not None:
+            # ZeRO-1: no full mean gradient is ever built — the
+            # reduce-scatter inside _zero1_update hands each replica
+            # its slice of it directly
+            lr = schedule(state.updates_applied)
+            new_params, new_bufs, num_contrib, applied = _zero1_update(
+                state.params, grads, state.momentum, flag, lr, momentum,
+                axis, z_plan)
+            new_state = state.replace(
+                params=new_params, momentum=new_bufs,
+                updates_applied=state.updates_applied + applied)
         else:
+            mean_grads, num_contrib = masked_mean_psum(grads, flag, axis)
             lr = schedule(state.updates_applied)
             applied = (num_contrib > 0).astype(jnp.int32)
             # If every replica was masked out (possible under timeout),
@@ -558,7 +784,7 @@ def build_eval_step(model: Model, cfg: ExperimentConfig, topo: Topology):
                              f"model {model.name!r} has no pipeline apply")
         tp_ax = model_ax if n_model > 1 else None
         ep_ax = topo.expert_axis if n_expert > 1 else None
-        pspec: Any = model.pp_param_specs(topo.stage_axis, tp_ax, ep_ax)
+        pspec: Any = params_partition_specs(model, cfg, topo)
         if (cfg.mesh.pipeline_schedule == "1f1b"
                 and getattr(model, "pp_1f1b_apply_factory", None) is None):
             # mirror the train-path guard: fail with a clear error at
@@ -596,13 +822,15 @@ def build_eval_step(model: Model, cfg: ExperimentConfig, topo: Topology):
                              "capable")
         tp_ax = model_ax if n_model > 1 else None
         ep_ax = topo.expert_axis if n_expert > 1 else None
-        pspec: Any = model.tp_param_specs(tp_ax, ep_ax)
+        pspec: Any = params_partition_specs(model, cfg, topo)
         tp_apply = model.sharded_apply_factory(None, tp_ax, ep_ax)
 
         def run(params, images):
             return tp_apply(params, images, None)
     else:
-        pspec = P()
+        # engine-derived per-leaf tree (all P() on a pure-DP mesh) —
+        # same derivation as the train step, one source of truth
+        pspec = params_partition_specs(model, cfg, topo)
 
         def run(params, images):
             return model.apply(params, images, train=False)
@@ -619,3 +847,51 @@ def build_eval_step(model: Model, cfg: ExperimentConfig, topo: Topology):
         in_specs=(pspec, P(axis)),
         out_specs=(P(), P(), P()))
     return jax.jit(sharded)
+
+
+def build_weight_update_step(model: Model, cfg: ExperimentConfig,
+                             topo: Topology, schedule: Schedule):
+    """Jitted ``(state, grads) -> state`` applying ONLY the gradient
+    aggregation + weight update — no forward/backward — under the
+    configured discipline (replicated, or ZeRO-1 when
+    ``parallel.shard_weight_update`` applies).
+
+    This isolates the exact region the ZeRO-1 paper optimizes so the
+    ``weight_update_sharding`` bench case (bench.py) can time it and
+    meter its per-chip optimizer-state bytes without the model compute
+    drowning the signal. ``grads`` is a params-shaped pytree placed per
+    ``params_partition_specs`` (replicated on a pure-DP mesh); its
+    values only feed the update, so a bench may pass any tree of the
+    right shapes.
+    """
+    axis = topo.replica_axis
+    momentum = cfg.optim.momentum
+    if cfg.sync.mode == "interval":
+        raise ValueError("build_weight_update_step models the per-step "
+                         "apply disciplines; interval mode applies on a "
+                         "wall-clock window (use build_train_step)")
+    state_specs = state_partition_specs(model, cfg, topo)
+    grad_specs = params_partition_specs(model, cfg, topo)
+    z_plan = zero1_plan_for(model, cfg, topo)
+
+    def shard_fn(state: TrainState, grads: Any) -> TrainState:
+        flag = jnp.ones((), jnp.float32)
+        lr = schedule(state.updates_applied)
+        if z_plan is not None:
+            new_params, new_bufs, _, applied = _zero1_update(
+                state.params, grads, state.momentum, flag, lr, momentum,
+                axis, z_plan)
+        else:
+            mean_grads, num = masked_mean_psum(grads, flag, axis)
+            new_params, new_bufs = _sgd(state.params, mean_grads,
+                                        state.momentum, lr, momentum)
+            applied = (num > 0).astype(jnp.int32)
+        return state.replace(params=new_params, momentum=new_bufs,
+                             step=state.step + 1,
+                             updates_applied=state.updates_applied + applied)
+
+    sharded = mesh_lib.shard_map(
+        shard_fn, mesh=topo.mesh,
+        in_specs=(state_specs, grad_specs),
+        out_specs=state_specs)
+    return jax.jit(sharded, donate_argnums=0)

@@ -1,0 +1,223 @@
+"""Declarative regex→PartitionSpec rules + the ZeRO-1 shard plan.
+
+Two jobs, one module:
+
+1. **The rule engine** (:func:`match_partition_rules`): map an ordered
+   list of ``("regex-on-param-path", PartitionSpec)`` rules over a
+   param pytree and produce the per-leaf spec tree. First match wins;
+   a leaf no rule covers is an explicit :class:`UnmatchedLeafError`
+   (silent replication of a tensor someone meant to shard is exactly
+   the bug a declarative table exists to prevent). Leaf paths are the
+   ``/``-joined pytree keys — ``blocks/0/wqkv`` in the per-layer-list
+   layout, ``blocks/wqkv`` in the stacked (scan/pipeline) layout — so
+   one table with both spellings covers both layouts. This replaces
+   the hand-built spec trees the models used to assemble shape-by-shape
+   (``transformer.param_partition_specs`` et al. remain as the parity
+   oracle; ``models/registry.py`` holds the per-model rule tables).
+
+2. **The ZeRO-1 shard plan** (:func:`make_zero1_plan`): given the param
+   tree and its spec tree, decide per leaf how the optimizer state and
+   the weight update shard across the ``replica`` axis (arXiv:
+   2004.13336). A leaf shards when it is replicated across every
+   non-replica axis and large enough to split; its flattened length is
+   padded up to a multiple of the replica count (``pad = ceil(size/n)·n``)
+   so uneven leaves shard evenly — the padding lives HERE, in the
+   engine, and every consumer (spec derivation, state init, the update
+   kernel, checkpoint pack/unpack) reads the same
+   :class:`LeafShardPlan`. Leaves smaller than the replica count (or a
+   configured floor), and leaves already sharded over a
+   model/stage/expert axis, fall back to their param placement —
+   replicated across replicas, exactly the pre-ZeRO behavior.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec
+
+# One rule: (regex matched against the "/"-joined leaf path via
+# re.search, PartitionSpec to assign). Ordered; first match wins.
+Rule = tuple[str, PartitionSpec]
+
+
+class UnmatchedLeafError(ValueError):
+    """A param leaf no partition rule covers. Deliberately loud: an
+    incomplete table must fail at build time, not silently replicate."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleAxes:
+    """The mesh axes a rule table may reference; ``None`` = that form
+    of parallelism is inactive and the table should leave those dims
+    unsharded (PartitionSpec treats None entries as replicated)."""
+
+    model: str | None = None
+    expert: str | None = None
+    stage: str | None = None
+
+
+def _key_name(k: Any) -> str:
+    # jax key-path entries: DictKey(.key), SequenceKey(.idx),
+    # GetAttrKey(.name), FlattenedIndexKey(.key)
+    for attr in ("key", "idx", "name"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def tree_path_names(tree: Any) -> list[str]:
+    """The "/"-joined leaf paths of ``tree``, in flatten order — the
+    names :func:`match_partition_rules` matches rules against."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return ["/".join(_key_name(k) for k in path) for path, _ in flat]
+
+
+def _leaf_size(leaf: Any) -> int:
+    shape = tuple(getattr(leaf, "shape", ()))
+    return int(np.prod(shape)) if shape else 1
+
+
+def match_partition_rules(rules: Sequence[Rule], tree: Any) -> Any:
+    """Map ordered ``(regex, PartitionSpec)`` rules over ``tree``.
+
+    Returns a tree of the same structure with a PartitionSpec per leaf.
+    Scalar / single-element leaves are never partitioned (always P(),
+    before any rule is consulted — the SNIPPETS.md [1] idiom). Every
+    other leaf takes the spec of the FIRST rule whose regex
+    ``re.search``-matches its path; a leaf with no matching rule raises
+    :class:`UnmatchedLeafError` naming the path and the table.
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+    specs = []
+    for path, leaf in flat:
+        name = "/".join(_key_name(k) for k in path)
+        if _leaf_size(leaf) <= 1:
+            specs.append(PartitionSpec())  # don't partition scalars
+            continue
+        for pat, spec in compiled:
+            if pat.search(name) is not None:
+                specs.append(spec)
+                break
+        else:
+            raise UnmatchedLeafError(
+                f"no partition rule matches param leaf {name!r} "
+                f"(shape {tuple(getattr(leaf, 'shape', ()))}); rules: "
+                f"{[pat for pat, _ in rules]}")
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def spec_is_replicated(spec: PartitionSpec) -> bool:
+    """True when ``spec`` pins no dim to any mesh axis."""
+    return all(entry is None for entry in tuple(spec))
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 shard plan
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class LeafShardPlan:
+    """Per-leaf ZeRO-1 decision. ``sharded`` leaves live flattened and
+    zero-padded to ``pad = chunk * n`` elements, split into one
+    ``chunk``-length slice per replica; fallback leaves keep their
+    logical ``shape`` and param placement. NOT a pytree node — whole
+    plans travel as leaves through ``jax.tree.map``."""
+
+    sharded: bool
+    size: int          # logical element count
+    pad: int           # padded flattened length (chunk * n)
+    chunk: int         # per-replica slice length
+    shape: tuple[int, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Zero1Plan:
+    """The whole-tree plan: ``leaf_plans`` mirrors the param treedef
+    with a :class:`LeafShardPlan` per leaf."""
+
+    axis: str          # the replica mesh axis
+    n: int             # replica count
+    leaf_plans: Any
+
+    @property
+    def any_sharded(self) -> bool:
+        return any(lp.sharded for lp in jax.tree.leaves(
+            self.leaf_plans, is_leaf=lambda x: isinstance(x, LeafShardPlan)))
+
+
+def make_zero1_plan(params: Any, param_specs: Any, axis: str, n: int,
+                    min_leaf_size: int = 0) -> Zero1Plan:
+    """Decide, per leaf, whether the optimizer state / weight update
+    shards over ``axis`` (``n`` replicas). ``params`` may be abstract
+    (``jax.eval_shape`` output). ``min_leaf_size``: smallest element
+    count that shards; 0 = auto (``n`` — a leaf smaller than the
+    replica count cannot give every replica a slice)."""
+    floor = max(n, min_leaf_size or n)
+
+    def leaf_plan(p: Any, spec: PartitionSpec) -> LeafShardPlan:
+        shape = tuple(p.shape)
+        size = _leaf_size(p)
+        sharded = bool(spec_is_replicated(spec) and size >= floor and n > 1)
+        chunk = -(-size // n)
+        return LeafShardPlan(sharded=sharded, size=size, pad=chunk * n,
+                             chunk=chunk, shape=shape)
+
+    return Zero1Plan(axis=axis, n=n,
+                     leaf_plans=jax.tree.map(leaf_plan, params, param_specs))
+
+
+def zero1_state_specs(plan: Zero1Plan, param_specs: Any) -> Any:
+    """Spec tree for replica-sharded optimizer state: sharded leaves
+    are 1-D ``[pad]`` arrays split over the replica axis; fallback
+    leaves keep the param placement."""
+    return jax.tree.map(
+        lambda lp, spec: (PartitionSpec(plan.axis) if lp.sharded else spec),
+        plan.leaf_plans, param_specs)
+
+
+def zero1_init_state(params: Any, plan: Zero1Plan) -> Any:
+    """Zeros-initialized optimizer-state tree in the plan's layout."""
+    import jax.numpy as jnp
+    return jax.tree.map(
+        lambda p, lp: (jnp.zeros((lp.pad,), p.dtype) if lp.sharded
+                       else jnp.zeros_like(p)),
+        params, plan.leaf_plans)
+
+
+def zero1_pack(tree: Any, plan: Zero1Plan) -> Any:
+    """Logical-shape tree → the plan's flattened-padded layout
+    (host-side numpy; the restore direction of the canonical-checkpoint
+    contract). Already-packed leaves pass through, so restoring a
+    flat-layout artifact is also exact."""
+    def pack(x: Any, lp: LeafShardPlan):
+        if not lp.sharded:
+            return x
+        a = np.asarray(x)
+        if a.shape == (lp.pad,):
+            return a  # already in the packed layout
+        a = a.reshape(-1)
+        if lp.pad != lp.size:
+            a = np.concatenate([a, np.zeros(lp.pad - lp.size, a.dtype)])
+        return a
+    return jax.tree.map(pack, tree, plan.leaf_plans)
+
+
+def zero1_unpack(tree: Any, plan: Zero1Plan) -> Any:
+    """The plan's flattened-padded layout → logical shapes (the save
+    direction: checkpoints always carry the canonical logical layout,
+    so artifacts — and their path digests — are identical whether the
+    run sharded its weight update or not)."""
+    def unpack(x: Any, lp: LeafShardPlan):
+        if not lp.sharded:
+            return x
+        a = np.asarray(jax.device_get(x))
+        if a.shape == lp.shape:
+            return a  # already logical (e.g. a replicated-run artifact)
+        return a.reshape(-1)[:lp.size].reshape(lp.shape)
+    return jax.tree.map(unpack, tree, plan.leaf_plans)

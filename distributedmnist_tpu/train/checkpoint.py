@@ -513,33 +513,82 @@ def state_params_digest(state: Any) -> str:
     return h.hexdigest()
 
 
-def checkpoint_params_digest(train_dir: str | Path,
-                             step: int | None = None
-                             ) -> tuple[str, int] | None:
-    """(sha256-of-params, step) for a saved checkpoint — computed from
-    the ARTIFACT alone (raw state dict, no model template), so the
-    invariant checker can compare two runs' checkpoints without
-    building either model. None when nothing is loadable. Single-file
-    layout only (the local chaos workers are single-process); a
-    sharded checkpoint raises so a silent cross-layout miscompare
-    cannot happen."""
-    train_dir = Path(train_dir)
+def _checkpoint_state_dict(train_dir: Path, step: int | None
+                           ) -> tuple[dict, int] | None:
+    """The raw saved state dict of a checkpoint artifact (no model
+    template) — the shared read behind the artifact digests. None when
+    nothing is loadable. Single-file layout only (the local chaos
+    workers are single-process); a sharded checkpoint raises so a
+    silent cross-layout miscompare cannot happen."""
     if step is None:
         step = latest_checkpoint_step(train_dir)
         if step is None:
             return None
     if _manifest_path(train_dir, step).exists():
         raise NotImplementedError(
-            "params digest over the sharded layout is not supported — "
+            "artifact digests over the sharded layout are not supported — "
             "restore through a template and use state_params_digest")
     path = _ckpt_path(train_dir, step)
     payload = _msgpack_restore_checked(_verified_read(path), path)
-    params = (payload.get("state") or {}).get("params")
-    if params is None:
+    state = payload.get("state")
+    if not isinstance(state, dict) or state.get("params") is None:
         raise CheckpointCorruptError(
             f"{path.name}: payload has no state/params entry")
+    return state, step
+
+
+def checkpoint_params_digest(train_dir: str | Path,
+                             step: int | None = None
+                             ) -> tuple[str, int] | None:
+    """(sha256-of-params, step) for a saved checkpoint — computed from
+    the ARTIFACT alone (raw state dict, no model template), so the
+    invariant checker can compare two runs' checkpoints without
+    building either model. None when nothing is loadable."""
+    got = _checkpoint_state_dict(Path(train_dir), step)
+    if got is None:
+        return None
+    state, step = got
     h = hashlib.sha256()
-    _digest_tree(params, h)
+    _digest_tree(state["params"], h)
+    return h.hexdigest(), step
+
+
+def checkpoint_state_digests(train_dir: str | Path,
+                             step: int | None = None
+                             ) -> tuple[str, str, int] | None:
+    """(params_digest, opt_state_digest, step) from ONE artifact read —
+    what the determinism invariant compares per worker; the split
+    functions below each re-read the file, so batch consumers use
+    this."""
+    got = _checkpoint_state_dict(Path(train_dir), step)
+    if got is None:
+        return None
+    state, step = got
+    hp, ho = hashlib.sha256(), hashlib.sha256()
+    _digest_tree(state["params"], hp)
+    _digest_tree(state.get("momentum"), ho)
+    return hp.hexdigest(), ho.hexdigest(), step
+
+
+def checkpoint_opt_state_digest(train_dir: str | Path,
+                                step: int | None = None
+                                ) -> tuple[str, int] | None:
+    """(sha256-of-optimizer-state, step) over the artifact's
+    ``momentum`` subtree — the optimizer-state half of the chaos
+    determinism invariant (obsv/invariants.py #3). Checkpoints store
+    momentum in the CANONICAL logical layout regardless of
+    ``parallel.shard_weight_update`` (train/loop.py ``_save`` via
+    parallel.api.canonical_save_state), so this digest is comparable
+    across runs — and meaningful, not skipped, for replica-sharded
+    optimizer state. A momentum-less run (momentum=0) digests the
+    canonical ``<none>`` marker, which still compares equal between a
+    trial and its reference."""
+    got = _checkpoint_state_dict(Path(train_dir), step)
+    if got is None:
+        return None
+    state, step = got
+    h = hashlib.sha256()
+    _digest_tree(state.get("momentum"), h)
     return h.hexdigest(), step
 
 

@@ -31,7 +31,9 @@ from .evaluation import run_full_eval
 from ..models.registry import Model, get_model
 from ..obsv.timing import StepTimeCollector
 from ..parallel.api import (TrainState, build_eval_step, build_train_step,
-                            init_train_state, state_partition_specs)
+                            canonical_save_state, init_train_state,
+                            pack_restored_state, state_partition_specs,
+                            zero1_plan_for)
 from . import checkpoint as ckpt
 from .lr_schedule import constant, decay_steps_for, exponential_decay
 
@@ -108,6 +110,13 @@ class Trainer:
         self.step_fn = build_train_step(self.model, cfg, self.topo, self.schedule)
         self.eval_fn = build_eval_step(self.model, cfg, self.topo)
         self.state_specs = state_partition_specs(self.model, cfg, self.topo)
+        # ZeRO-1 shard plan (parallel.shard_weight_update): governs the
+        # momentum layout in self.state AND the checkpoint conversion —
+        # artifacts always carry the canonical logical layout
+        # (parallel/api.py canonical_save_state), so a sharded run's
+        # checkpoint restores onto any discipline and the path digests
+        # stay stable across the knob.
+        self._zero1_plan = zero1_plan_for(self.model, cfg, self.topo)
         self.state: TrainState = init_train_state(self.model, cfg, self.topo)
         self.state = self.topo.device_put_state(self.state, self.state_specs)
 
@@ -240,6 +249,10 @@ class Trainer:
         if restored is None:
             return
         state, extra, step = restored
+        # checkpoints carry the canonical logical optimizer layout —
+        # fold it back into the replica-shard layout the live state
+        # uses (no-op without a plan / without momentum)
+        state = pack_restored_state(state, self._zero1_plan)
         # The gpipe layer-stacked and 1f1b chunk-interleaved layouts
         # have identical tree structure and leaf shapes but DIFFERENT
         # layer order — a shape-matched restore across schedules would
@@ -287,15 +300,25 @@ class Trainer:
         if callable(iter_state) and getattr(self.train_feed, "has_state", True):
             extra["data_iter"] = self.train_feed.state()
         at_step = int(jax.device_get(self.state.step))
+        # canonical layout on disk: replica-sharded (ZeRO-1) momentum is
+        # unpacked to its logical shapes so the artifact — and its
+        # canonical path digest — is identical to a replicated run's.
+        # Only when this process can materialize the buffers (always
+        # true single-process); a cross-process sharded layout saves
+        # its live layout via the per-host shard format instead.
+        state_to_save = self.state
+        if (self._zero1_plan is not None
+                and not ckpt.state_needs_sharded_save(self.state)):
+            state_to_save = canonical_save_state(self.state, self._zero1_plan)
         if self._use_async_ckpt:
             if self._checkpointer is None or self._checkpointer.closed:
                 self._checkpointer = ckpt.AsyncCheckpointer()
-            self._checkpointer.save(self.train_dir, self.state, at_step,
+            self._checkpointer.save(self.train_dir, state_to_save, at_step,
                                     extra=extra,
                                     keep=self.cfg.train.keep_checkpoints,
                                     no_skip=self._sharded_ckpt)
         else:
-            ckpt.save_checkpoint(self.train_dir, self.state, at_step,
+            ckpt.save_checkpoint(self.train_dir, state_to_save, at_step,
                                  extra=extra,
                                  keep=self.cfg.train.keep_checkpoints)
         self._last_save_time = time.time()
@@ -321,6 +344,7 @@ class Trainer:
                                       "action": "rollback_candidate_poisoned",
                                       "step": s})
                 continue
+            state = pack_restored_state(state, self._zero1_plan)
             self.state = self.topo.device_put_state(state, self.state_specs)
             if "data_iter" in extra:
                 try:

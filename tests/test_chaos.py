@@ -43,6 +43,89 @@ def _cluster(tmp_path, fault_plan=None, num_workers=2):
 
 
 # ---------------------------------------------------------------------------
+# drain: boot-aware per-worker stall clocks
+# ---------------------------------------------------------------------------
+
+class _StubDrainCluster:
+    """Duck-typed stand-in for LocalProcessCluster: one live worker
+    with a fixed progress reading and a controllable spawned_at."""
+
+    def __init__(self, logdir, spawned_at):
+        self._worker = {"worker": 0, "pid": 1, "alive": True,
+                        "logdir": str(logdir), "spawned_at": spawned_at}
+
+    def status(self):
+        return {"state": "RUNNING", "workers": [dict(self._worker)]}
+
+    def worker_progress(self):
+        return {0: 7}  # static: no log movement, ever
+
+
+def test_drain_stall_clock_waits_for_post_restart_first_log(tmp_path):
+    """PR 4 rough edge: a worker restarted near the end of the run
+    spends a whole jax boot (> drain_stall_s) with no log movement, and
+    the old global stall clock killed it mid-boot. The clock must not
+    start until the worker has logged at least one line AFTER its own
+    (re)spawn; a genuinely stalled (already-logging) worker still gets
+    the early give-up."""
+    import time
+
+    cfg = ChaosConfig(name="drain-t", workdir=str(tmp_path),
+                      payload="shell", poll_secs=0.05,
+                      drain_stall_s=0.25, drain_timeout_s=1.2)
+    camp = ChaosCampaign(cfg)
+    logdir = tmp_path / "worker0"
+    logdir.mkdir()
+    log = logdir / "train_log.jsonl"
+    log.write_text('{"step": 7, "loss": 1.0}\n')
+
+    # (a) mid-boot: the respawn postdates the last log line — the stall
+    # clock stays parked and the drain rides to its hard timeout
+    booting = _StubDrainCluster(logdir, spawned_at=time.time() + 3600)
+    t0 = time.monotonic()
+    camp._drain(booting)
+    waited = time.monotonic() - t0
+    assert waited >= 1.0, f"gave up on a booting worker after {waited:.2f}s"
+
+    # (b) logged since its spawn, then stalled: early give-up applies
+    stalled = _StubDrainCluster(logdir, spawned_at=time.time() - 3600)
+    t0 = time.monotonic()
+    camp._drain(stalled)
+    waited = time.monotonic() - t0
+    assert 0.2 <= waited < 1.0, f"early give-up missed ({waited:.2f}s)"
+
+    # (c) no spawn timestamp at all (pre-upgrade state file): legacy
+    # behavior — the stall clock runs
+    legacy = _StubDrainCluster(logdir, spawned_at=None)
+    t0 = time.monotonic()
+    camp._drain(legacy)
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_spawned_at_recorded_and_surfaced(tmp_path):
+    """LocalProcessCluster stamps each incarnation's spawn time into
+    the state file and status() — what the drain's boot detection keys
+    off."""
+    import time
+
+    cluster = _cluster(tmp_path)
+    try:
+        cluster.create()
+        before = time.time()
+        cluster.run_train()
+        st = cluster.status()
+        w = st["workers"][0]
+        assert w["spawned_at"] is not None and w["spawned_at"] >= before
+        first = w["spawned_at"]
+        cluster.restart_worker(0)
+        st = cluster.status()
+        assert st["workers"][0]["spawned_at"] >= first
+    finally:
+        cluster.kill_all()
+        cluster.exec.close()
+
+
+# ---------------------------------------------------------------------------
 # schedule generation
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,231 @@
+"""The regex partition-rule engine (parallel/partition_rules.py):
+first-match-wins semantics, the explicit unmatched-leaf error, stacked
+(scan/pipeline) layer paths, and — the load-bearing property — parity
+of the engine-derived spec trees against the models' hand-built
+``tp_param_specs`` / ``pp_param_specs`` output for every model family
+and axis combination the meshes use."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributedmnist_tpu.core.config import ExperimentConfig, MeshConfig
+from distributedmnist_tpu.core.mesh import make_topology
+from distributedmnist_tpu.models.registry import get_model
+from distributedmnist_tpu.parallel.api import (abstract_train_params,
+                                               params_partition_specs)
+from distributedmnist_tpu.parallel.partition_rules import (
+    LeafShardPlan, UnmatchedLeafError, make_zero1_plan,
+    match_partition_rules, spec_is_replicated, tree_path_names, zero1_pack,
+    zero1_state_specs, zero1_unpack)
+
+pytestmark = pytest.mark.tier1
+
+IS_SPEC = lambda x: isinstance(x, P)  # noqa: E731
+
+
+def assert_spec_trees_equal(got, want):
+    gl, gt = jax.tree.flatten(got, is_leaf=IS_SPEC)
+    wl, wt = jax.tree.flatten(want, is_leaf=IS_SPEC)
+    assert gt == wt, f"structure mismatch: {gt} != {wt}"
+    assert gl == wl, f"spec mismatch:\n  got  {gl}\n  want {wl}"
+
+
+# ---------------------------------------------------------------------------
+# engine semantics
+# ---------------------------------------------------------------------------
+
+def test_first_match_wins_ordering():
+    tree = {"a": {"w": np.zeros((4, 4))}, "b": np.zeros((4,))}
+    specs = match_partition_rules(
+        [(r"a/w$", P("x")), (r".*", P())], tree)
+    assert specs["a"]["w"] == P("x") and specs["b"] == P()
+    # the same table reversed: the catch-all eats everything first
+    specs = match_partition_rules(
+        [(r".*", P()), (r"a/w$", P("x"))], tree)
+    assert specs["a"]["w"] == P() and specs["b"] == P()
+
+
+def test_unmatched_leaf_is_an_explicit_error():
+    tree = {"covered": np.zeros((4,)), "orphan": np.zeros((4, 4))}
+    with pytest.raises(UnmatchedLeafError, match="orphan"):
+        match_partition_rules([(r"^covered$", P())], tree)
+
+
+def test_scalars_never_partition():
+    tree = {"scalar": np.zeros(()), "one": np.zeros((1,)),
+            "vec": np.zeros((4,))}
+    # the catch-all names an axis; scalars must still come out P()
+    specs = match_partition_rules([(r".*", P("x"))], tree)
+    assert specs["scalar"] == P() and specs["one"] == P()
+    assert specs["vec"] == P("x")
+
+
+def test_paths_cover_list_and_stacked_layouts():
+    from distributedmnist_tpu.models import transformer
+    params = transformer.init(jax.random.PRNGKey(0), num_layers=2,
+                              vocab_size=16, model_dim=8, num_heads=2,
+                              max_seq_len=8)
+    flat_paths = set(tree_path_names(params))
+    assert "blocks/0/wqkv" in flat_paths and "blocks/1/w2" in flat_paths
+    stacked_paths = set(tree_path_names(
+        transformer.stack_block_params(params)))
+    assert "blocks/wqkv" in stacked_paths
+    assert "blocks/ln1/scale" in stacked_paths
+
+
+# ---------------------------------------------------------------------------
+# parity: engine-derived specs vs the hand-built spec trees
+# ---------------------------------------------------------------------------
+
+def _transformer_cfg(**model):
+    d = {"name": "transformer", "num_layers": 4, "num_heads": 4,
+         "model_dim": 32, "seq_len": 16, "vocab_size": 64,
+         "compute_dtype": "float32", "dropout_rate": 0.0}
+    d.update(model)
+    return ExperimentConfig.from_dict({"model": d})
+
+
+def test_replicated_models_derive_all_replicated(topo8):
+    for name in ("mnist_cnn", "resnet20"):
+        cfg = ExperimentConfig.from_dict({"model": {"name": name}})
+        model = get_model(cfg.model)
+        specs = params_partition_specs(model, cfg, topo8)
+        leaves = jax.tree.leaves(specs, is_leaf=IS_SPEC)
+        assert leaves and all(spec_is_replicated(s) for s in leaves)
+
+
+@pytest.mark.parametrize("num_experts", [0, 4])
+def test_engine_matches_hand_built_tp_specs(num_experts):
+    cfg = _transformer_cfg(num_experts=num_experts)
+    topo = make_topology(MeshConfig(
+        num_replicas=2, model_parallelism=2,
+        expert_parallelism=2 if num_experts else 1))
+    model = get_model(cfg.model)
+    got = params_partition_specs(model, cfg, topo)
+    want = model.tp_param_specs(
+        topo.model_axis, topo.expert_axis if num_experts else None)
+    assert_spec_trees_equal(got, want)
+
+
+@pytest.mark.parametrize("tp,ep", [(False, False), (True, False),
+                                   (True, True)])
+def test_engine_matches_hand_built_pp_specs(tp, ep):
+    num_experts = 4 if ep else 0
+    cfg = _transformer_cfg(num_experts=num_experts)
+    topo = make_topology(MeshConfig(
+        num_replicas=1, pipeline_parallelism=2,
+        model_parallelism=2 if tp else 1,
+        expert_parallelism=2 if ep else 1))
+    model = get_model(cfg.model)
+    got = params_partition_specs(model, cfg, topo)
+    want = model.pp_param_specs(
+        topo.stage_axis, topo.model_axis if tp else None,
+        topo.expert_axis if ep else None)
+    assert_spec_trees_equal(got, want)
+
+
+def test_engine_specs_cover_1f1b_chunked_layout():
+    """The chunk-interleaved (1f1b) layout has the same tree structure
+    as the stacked one — the engine's stacked rules must cover it."""
+    cfg = _transformer_cfg().override({"mesh.pipeline_schedule": "1f1b",
+                                       "mesh.pipeline_chunks": 2,
+                                       "mesh.pipeline_parallelism": 2,
+                                       "mesh.num_replicas": 1})
+    topo = make_topology(cfg.mesh)
+    model = get_model(cfg.model)
+    got = params_partition_specs(model, cfg, topo)
+    want = model.pp_param_specs(topo.stage_axis, None, None)
+    assert_spec_trees_equal(got, want)
+
+
+def test_capable_model_without_rule_table_refuses_sharded_mesh():
+    """A model that passes the TP capability check but declares no rule
+    table must fail loudly — the replicated fallback table would
+    silently double-count its model-axis psums."""
+    import jax.numpy as jnp
+
+    from distributedmnist_tpu.models.registry import Model
+    dummy = Model(
+        name="dummy", init=lambda k: {"w": jnp.zeros((4, 4))},
+        apply=lambda p, x, **kw: x, loss=lambda l, y: l.sum(),
+        accuracy=lambda l, y: l.sum(), input_shape=(4,),
+        tp_param_specs=lambda m, e=None: {"w": P(None, m)},
+        sharded_apply_factory=lambda *a, **kw: None)
+    cfg = ExperimentConfig.from_dict({})
+    topo = make_topology(MeshConfig(num_replicas=1, model_parallelism=2))
+    with pytest.raises(ValueError, match="partition_rules"):
+        params_partition_specs(dummy, cfg, topo)
+
+
+def test_unsupported_mesh_still_raises():
+    """The engine path must preserve the capability errors: a mesh
+    demanding TP from a TP-less model fails loudly at spec time."""
+    cfg = ExperimentConfig.from_dict({"model": {"name": "mnist_cnn"}})
+    model = get_model(cfg.model)
+    topo = make_topology(MeshConfig(num_replicas=1, model_parallelism=2))
+    with pytest.raises(ValueError, match="tensor-parallel"):
+        params_partition_specs(model, cfg, topo)
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 shard plan
+# ---------------------------------------------------------------------------
+
+def test_zero1_plan_padding_and_fallbacks():
+    tree = {"big": np.zeros((10,), np.float32),      # uneven: pads 10→16
+            "tiny": np.zeros((4,), np.float32),      # < n: falls back
+            "tp": np.zeros((8, 8), np.float32)}      # sharded elsewhere
+    specs = {"big": P(), "tiny": P(), "tp": P(None, "model")}
+    plan = make_zero1_plan(tree, specs, "replica", 8)
+    lp = plan.leaf_plans
+    assert lp["big"].sharded and lp["big"].pad == 16 and lp["big"].chunk == 2
+    assert not lp["tiny"].sharded
+    assert not lp["tp"].sharded  # tensor-parallel leaf keeps its placement
+    mspecs = zero1_state_specs(plan, specs)
+    assert mspecs["big"] == P("replica")
+    assert mspecs["tiny"] == P() and mspecs["tp"] == P(None, "model")
+
+
+def test_zero1_pack_unpack_exact_roundtrip():
+    rng = np.random.default_rng(0)
+    tree = {"w": rng.normal(size=(3, 7)).astype(np.float32),
+            "tiny": rng.normal(size=(2,)).astype(np.float32)}
+    specs = {"w": P(), "tiny": P()}
+    plan = make_zero1_plan(tree, specs, "replica", 8)
+    packed = zero1_pack(tree, plan)
+    assert packed["w"].shape == (24,)              # 21 → pad 24
+    assert np.all(packed["w"][21:] == 0)
+    assert packed["tiny"].shape == (2,)            # fallback untouched
+    back = zero1_unpack(packed, plan)
+    np.testing.assert_array_equal(back["w"], tree["w"])
+    np.testing.assert_array_equal(back["tiny"], tree["tiny"])
+    # packing an already-packed tree is the identity (flat-layout
+    # artifacts restore exactly too)
+    repacked = zero1_pack(packed, plan)
+    np.testing.assert_array_equal(repacked["w"], packed["w"])
+
+
+def test_zero1_min_leaf_size_floor():
+    tree = {"w": np.zeros((64,), np.float32)}
+    specs = {"w": P()}
+    plan = make_zero1_plan(tree, specs, "replica", 8, min_leaf_size=128)
+    assert not plan.leaf_plans["w"].sharded
+    assert not plan.any_sharded
+
+
+def test_plan_mirrors_abstract_params_tree(topo8):
+    """The plan the state/init/update/checkpoint consumers share is
+    derived from abstract (eval_shape) params — its structure must
+    match the real param tree exactly."""
+    cfg = ExperimentConfig.from_dict(
+        {"model": {"name": "mnist_cnn"},
+         "parallel": {"shard_weight_update": True}})
+    model = get_model(cfg.model)
+    abstract = abstract_train_params(model, cfg, topo8)
+    specs = params_partition_specs(model, cfg, topo8, params=abstract)
+    plan = make_zero1_plan(abstract, specs, topo8.replica_axis, 8)
+    is_lp = lambda x: isinstance(x, LeafShardPlan)  # noqa: E731
+    assert (jax.tree.structure(plan.leaf_plans, is_leaf=is_lp)
+            == jax.tree.structure(abstract))

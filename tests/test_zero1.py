@@ -1,0 +1,271 @@
+"""ZeRO-1 cross-replica sharded weight update
+(``parallel.shard_weight_update``, arXiv:2004.13336): numerics parity
+vs the replicated baseline on the 8-device mesh, masking semantics,
+per-chip optimizer-state accounting, and the canonical-layout
+checkpoint contract (save→restore roundtrip, restore across the knob,
+digest stability for the determinism invariant)."""
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import LOSS_TOL, assert_update_parity, base_config
+from distributedmnist_tpu.data.datasets import make_synthetic
+from distributedmnist_tpu.models.registry import get_model
+from distributedmnist_tpu.parallel.api import (build_train_step,
+                                               canonical_save_state,
+                                               init_train_state,
+                                               pack_restored_state,
+                                               state_partition_specs,
+                                               zero1_plan_for)
+from distributedmnist_tpu.train import checkpoint as ckpt
+from distributedmnist_tpu.train.loop import Trainer
+from distributedmnist_tpu.train.lr_schedule import constant
+
+pytestmark = pytest.mark.tier1
+
+LR = 0.1
+
+
+def _cfg(shard: bool, **over):
+    sections = {"optim": {"momentum": 0.9},
+                "parallel": {"shard_weight_update": shard}}
+    for k, v in over.items():
+        if isinstance(v, dict) and k in sections:
+            sections[k].update(v)
+        else:
+            sections[k] = v
+    return base_config(**sections)
+
+
+def _run_steps(cfg, topo, batch, steps=4):
+    model = get_model(cfg.model)
+    state = topo.device_put_state(init_train_state(model, cfg, topo),
+                                  state_partition_specs(model, cfg, topo))
+    step_fn = build_train_step(model, cfg, topo, constant(LR))
+    gbatch = topo.device_put_batch(batch)
+    metrics_hist = []
+    for _ in range(steps):
+        state, m = step_fn(state, gbatch)
+        metrics_hist.append(m)
+    return state, metrics_hist
+
+
+@pytest.fixture(scope="module")
+def batch64():
+    ds = make_synthetic(num_train=64, num_test=16)
+    return {"image": ds.train.images[:64], "label": ds.train.labels[:64]}
+
+
+def test_sharded_update_matches_replicated_sync(topo8, batch64):
+    st_r, hist_r = _run_steps(_cfg(False), topo8, batch64)
+    st_s, hist_s = _run_steps(_cfg(True), topo8, batch64)
+    for mr, ms in zip(hist_r, hist_s):
+        np.testing.assert_allclose(float(ms["loss"]), float(mr["loss"]),
+                                   **LOSS_TOL)
+    # pure-DP ZeRO-1 has no pcast-transpose caveat: compare params
+    # directly too (tight), on top of the shim-aware helper
+    assert_update_parity(jax.device_get(st_s.params),
+                         jax.device_get(st_r.params))
+    for a, b in zip(jax.tree.leaves(jax.device_get(st_s.params)),
+                    jax.tree.leaves(jax.device_get(st_r.params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+    # and the sharded momentum unpacks to the replicated buffers
+    plan = zero1_plan_for(get_model(_cfg(True).model), _cfg(True), topo8)
+    mom_s = canonical_save_state(st_s, plan).momentum
+    for a, b in zip(jax.tree.leaves(mom_s),
+                    jax.tree.leaves(jax.device_get(st_r.momentum))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_sharded_update_matches_replicated_quorum(topo8, batch64):
+    """Quorum masking composes: the same deterministic straggler draws
+    select the same contributors under both disciplines, so losses and
+    params agree."""
+    over = {"sync": {"mode": "quorum", "num_replicas_to_aggregate": 5,
+                     "straggler_profile": "lognormal"}}
+    st_r, hist_r = _run_steps(_cfg(False, **over), topo8, batch64)
+    st_s, hist_s = _run_steps(_cfg(True, **over), topo8, batch64)
+    for mr, ms in zip(hist_r, hist_s):
+        assert float(ms["num_contributors"]) == 5.0
+        np.testing.assert_allclose(float(ms["loss"]), float(mr["loss"]),
+                                   **LOSS_TOL)
+    for a, b in zip(jax.tree.leaves(jax.device_get(st_s.params)),
+                    jax.tree.leaves(jax.device_get(st_r.params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_all_masked_step_is_true_noop(topo8, batch64):
+    """timeout_ms=0 masks every replica: params, momentum and
+    updates_applied must come through bitwise untouched (momentum decay
+    is select-guarded on the shards)."""
+    cfg = _cfg(True, sync={"mode": "timeout", "timeout_ms": 0.0})
+    model = get_model(cfg.model)
+    state = topo8.device_put_state(init_train_state(model, cfg, topo8),
+                                   state_partition_specs(model, cfg, topo8))
+    before_p = jax.device_get(state.params)
+    before_m = jax.device_get(state.momentum)
+    step_fn = build_train_step(model, cfg, topo8, constant(LR))
+    state, m = step_fn(state, topo8.device_put_batch(batch64))
+    assert float(m["num_contributors"]) == 0.0
+    assert int(jax.device_get(state.updates_applied)) == 0
+    for a, b in zip(jax.tree.leaves(before_p),
+                    jax.tree.leaves(jax.device_get(state.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(before_m),
+                    jax.tree.leaves(jax.device_get(state.momentum))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_momentum_state_is_replica_sharded(topo8):
+    """The memory claim itself: per-chip momentum bytes under ZeRO-1
+    land at ~1/8 of replicated (padding slack only)."""
+    def bytes_per_chip(cfg):
+        model = get_model(cfg.model)
+        state = topo8.device_put_state(
+            init_train_state(model, cfg, topo8),
+            state_partition_specs(model, cfg, topo8))
+        return sum(
+            int(np.prod(l.sharding.shard_shape(l.shape))) * l.dtype.itemsize
+            for l in jax.tree.leaves(state.momentum))
+    rep, shd = bytes_per_chip(_cfg(False)), bytes_per_chip(_cfg(True))
+    assert shd <= rep * (1 / 8 + 0.02), (shd, rep)
+
+
+def test_interval_mode_falls_back_replicated(topo8):
+    """interval mode keeps the windowed accumulator replicated: the
+    knob is a documented no-op (plan None), and the step still builds
+    and runs."""
+    from jax.sharding import PartitionSpec
+    from distributedmnist_tpu.parallel.partition_rules import \
+        spec_is_replicated
+    cfg = _cfg(True, sync={"mode": "interval", "interval_ms": 10.0})
+    model = get_model(cfg.model)
+    assert zero1_plan_for(model, cfg, topo8) is None
+    specs = state_partition_specs(model, cfg, topo8)
+    assert all(spec_is_replicated(s) for s in jax.tree.leaves(
+        specs.momentum, is_leaf=lambda x: isinstance(x, PartitionSpec)))
+    build_train_step(model, cfg, topo8, constant(LR))  # must not raise
+
+
+# ---------------------------------------------------------------------------
+# checkpoint contract
+# ---------------------------------------------------------------------------
+
+def _trainer_cfg(shard: bool, train_dir: str, max_steps: int = 4):
+    return _cfg(shard, train={"max_steps": max_steps, "log_every_steps": 2,
+                              "save_interval_steps": 2,
+                              "save_results_period": 0,
+                              "train_dir": train_dir,
+                              "async_checkpoint": False})
+
+
+def test_checkpoint_roundtrip_and_cross_knob_restore(tmp_path,
+                                                     synthetic_datasets):
+    """Save→restore roundtrip of replica-sharded opt state is exact;
+    the artifact is canonical, so it restores onto
+    shard_weight_update=false (and the digests are the ones a
+    replicated same-seed run produces)."""
+    d1 = str(tmp_path / "shard")
+    t1 = Trainer(_trainer_cfg(True, d1), topo=None,
+                 datasets=synthetic_datasets)
+    assert t1._zero1_plan is not None
+    t1.run()
+    flat_momentum = jax.device_get(t1.state.momentum)
+    digest = ckpt.state_params_digest(t1.state)
+
+    # resume under the SAME knob: momentum packs back bitwise
+    t2 = Trainer(_trainer_cfg(True, d1), datasets=synthetic_datasets)
+    assert int(jax.device_get(t2.state.step)) == 4
+    for a, b in zip(jax.tree.leaves(flat_momentum),
+                    jax.tree.leaves(jax.device_get(t2.state.momentum))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert ckpt.state_params_digest(t2.state) == digest
+
+    # restore onto the replicated discipline: canonical layout loads
+    # with no migration, momentum arrives in logical shapes
+    t3 = Trainer(_trainer_cfg(False, d1), datasets=synthetic_datasets)
+    assert t3._zero1_plan is None
+    assert int(jax.device_get(t3.state.step)) == 4
+    logical = canonical_save_state(
+        t1.state, t1._zero1_plan).momentum
+    for a, b in zip(jax.tree.leaves(logical),
+                    jax.tree.leaves(jax.device_get(t3.state.momentum))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert ckpt.state_params_digest(t3.state) == digest
+
+    # the reverse direction: a replicated run's checkpoint restores
+    # onto shard_weight_update=true (pack on restore). d2 doubles as
+    # the digest-stability acceptance: the replicated same-seed run's
+    # artifact hashes identically (params AND canonical opt state) to
+    # the sharded run's — what lets chaos invariant 3 compare runs
+    # without caring which discipline produced which.
+    d2 = str(tmp_path / "rep")
+    t4 = Trainer(_trainer_cfg(False, d2), datasets=synthetic_datasets)
+    t4.run()
+    assert (ckpt.checkpoint_params_digest(d1)
+            == ckpt.checkpoint_params_digest(d2))
+    assert (ckpt.checkpoint_opt_state_digest(d1)
+            == ckpt.checkpoint_opt_state_digest(d2))
+    t5 = Trainer(_trainer_cfg(True, d2), datasets=synthetic_datasets)
+    assert int(jax.device_get(t5.state.step)) == 4
+    packed = pack_restored_state(
+        canonical_save_state(t5.state, t5._zero1_plan), t5._zero1_plan)
+    for leaf, lp in zip(
+            jax.tree.leaves(packed.momentum),
+            jax.tree.leaves(t5._zero1_plan.leaf_plans,
+                            is_leaf=lambda x: hasattr(x, "sharded"))):
+        if lp.sharded:
+            assert leaf.shape == (lp.pad,)
+
+
+def test_determinism_invariant_covers_opt_state(tmp_path):
+    """obsv/invariants.py #3: identical artifacts pass with the
+    opt-state digest compared (not skipped); a doctored momentum buffer
+    in an otherwise-identical checkpoint is a determinism violation.
+    Handcrafted checkpoints — the verdict reads artifacts alone, no
+    Trainer needed."""
+    from distributedmnist_tpu.obsv.invariants import determinism_verdict
+
+    state = {"params": {"w": np.arange(8, dtype=np.float32)},
+             "momentum": {"w": np.full(8, 0.25, np.float32)},
+             "step": np.int32(4)}
+    ref = tmp_path / "ref"
+    trial = tmp_path / "trial" / "worker0"
+    for d in (ref, trial):
+        ckpt.save_checkpoint(d, ("full", state), step=4)
+    checked, violations = determinism_verdict(trial, ref)
+    assert checked and violations == []
+
+    # doctor ONLY the momentum in the trial's latest checkpoint
+    import hashlib
+
+    from flax import serialization
+
+    def bump_first_array(node):
+        for k in sorted(node):
+            if isinstance(node[k], dict):
+                if bump_first_array(node[k]):
+                    return True
+            else:
+                leaf = np.array(node[k])
+                leaf.reshape(-1)[0] += 1.0
+                node[k] = leaf
+                return True
+        return False
+
+    step = ckpt.latest_checkpoint_step(trial)
+    path = trial / f"ckpt-{step:08d}.msgpack"
+    payload = serialization.msgpack_restore(path.read_bytes())
+    assert bump_first_array(payload["state"]["momentum"])
+    data = serialization.msgpack_serialize(payload)
+    path.write_bytes(data)
+    (trial / (path.name + ".sha256")).write_text(
+        hashlib.sha256(data).hexdigest())
+
+    checked, violations = determinism_verdict(trial, ref)
+    assert checked
+    assert any("optimizer state" in v.detail for v in violations)
