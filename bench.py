@@ -665,6 +665,197 @@ def bench_weight_update_sharding() -> dict:
             **_env_stamp()}}
 
 
+def bench_restart_latency() -> dict:
+    """Restart-latency fast path (ROADMAP item 5), measured end-to-end
+    on the local process cluster with REAL ``launch train`` worker
+    processes. Three recovery disciplines, same payload (the chaos
+    train payload's shape: 2-device simulated mesh, momentum + ZeRO-1):
+
+      * **cold** — spawn with the persistent compile cache DISABLED:
+        process boot + full XLA compile + first step.
+      * **warm** — spawn against a shared pre-primed compile cache:
+        boot + cache deserialize instead of compile.
+      * **standby** — promote a parked, precompiled spare: no boot, no
+        compile, just adopt-logdir + resume.
+
+    The measured quantity is spawn(or promotion)→first-moved-step — the
+    exact recovery leg every supervisor restart and chaos trial pays.
+    Gates (vs the cold median): warm ≤ 0.6×, standby ≤ 0.3×. The warm
+    gate SKIPS honestly when the platform persisted no cache entries
+    during the prime run (nothing to be warm from)."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    from distributedmnist_tpu.launch.cluster import (LocalClusterConfig,
+                                                     LocalProcessCluster)
+    from distributedmnist_tpu.launch.exec import CommandExecutor, RetryPolicy
+
+    workdir = tempfile.mkdtemp(prefix="dmt_restart_bench_")
+    payload = (
+        "python -m distributedmnist_tpu.launch train "
+        "train.train_dir=. data.dataset=synthetic data.batch_size=32 "
+        "data.synthetic_train_size=256 data.synthetic_test_size=64 "
+        "model.compute_dtype=float32 mesh.simulate_devices=2 "
+        "optim.momentum=0.9 parallel.shard_weight_update=true "
+        "train.max_steps=500 train.log_every_steps=1 "
+        "train.save_interval_steps=5 train.async_checkpoint=false "
+        "train.save_results_period=0")
+
+    def first_step_after(cluster, anchor: float, timeout_s: float = 300.0,
+                         keep_log: bool = False) -> float:
+        """Seconds from ``anchor`` to the worker's first step record
+        stamped at/after it (the artifact timestamps, not poll
+        granularity)."""
+        from distributedmnist_tpu.obsv.report import load_jsonl
+        log = Path(cluster.cfg.worker_dir(0)) / "train_log.jsonl"
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            for rec in load_jsonl(log, "step"):
+                if (isinstance(rec.get("time"), (int, float))
+                        and rec["time"] >= anchor):
+                    return rec["time"] - anchor
+            time.sleep(0.25)
+        raise RuntimeError(
+            f"no step record within {timeout_s:.0f}s of the (re)spawn "
+            f"({'existing' if keep_log else 'fresh'} log: {log})")
+
+    def spawn_and_time(cluster) -> float:
+        """One cold-ish sample: fresh worker dir, spawn, time to the
+        first moved step, then stop the worker."""
+        cluster.kill_all()
+        wdir = Path(cluster.cfg.worker_dir(0))
+        if wdir.exists():
+            shutil.rmtree(wdir)
+        wdir.mkdir(parents=True)
+        cluster.run_train()
+        anchor = cluster.status()["workers"][0]["spawned_at"]
+        try:
+            return first_step_after(cluster, anchor)
+        finally:
+            cluster.kill_all()
+
+    clusters: list[LocalProcessCluster] = []
+
+    def make_cluster(name: str, cache: bool,
+                     standby: bool = False) -> LocalProcessCluster:
+        cfg = LocalClusterConfig(
+            name=name, num_workers=1, workdir=workdir,
+            train_command=payload,
+            compile_cache=cache,
+            compile_cache_dir=(str(Path(workdir) / "shared_cache")
+                               if cache else ""))
+        ex = CommandExecutor(journal=cfg.root / "command_journal.jsonl",
+                             retry=RetryPolicy(max_attempts=1))
+        c = LocalProcessCluster(cfg, ex)
+        c.create()
+        clusters.append(c)
+        return c
+
+    def compile_events(cluster) -> list[dict]:
+        from distributedmnist_tpu.obsv.report import load_jsonl
+        return load_jsonl(Path(cluster.cfg.worker_dir(0))
+                          / "train_log.jsonl", "compile")
+
+    detail: dict = {"payload": payload, **_env_stamp()}
+    try:
+        # --- cold arm: no cache at all --------------------------------
+        cold_cluster = make_cluster("cold", cache=False)
+        cold = [spawn_and_time(cold_cluster) for _ in range(3)]
+        cold_cluster.delete()
+        cold_median = statistics.median(cold)
+
+        # --- warm arm: prime the shared cache, then measure -----------
+        from distributedmnist_tpu.core.compile_cache import cache_stats
+        warm_cluster = make_cluster("warm", cache=True)
+        cache_dir = warm_cluster.cfg.resolved_compile_cache_dir()
+        prime = spawn_and_time(warm_cluster)
+        primed = cache_stats(cache_dir)
+        warm: list[float] = []
+        warm_skipped = None
+        if primed["entries"] == 0:
+            warm_skipped = ("platform persisted no compile-cache "
+                            "entries — nothing to be warm from")
+        else:
+            warm = [spawn_and_time(warm_cluster) for _ in range(2)]
+        # dir-level stats only: hit/miss counters are PER PROCESS (they
+        # move in the workers, not in this bench process — reporting
+        # ours here would upload meaningless zeros); the per-worker
+        # hit evidence is worker_compile_events' persistent_cache
+        # block (new_entries == 0 on a warm boot)
+        cstats = cache_stats(cache_dir)
+        detail["compile_cache"] = {
+            "dir": cstats["dir"], "entries": cstats["entries"],
+            "bytes": cstats["bytes"],
+            "entries_after_prime": primed["entries"]}
+        detail["worker_compile_events"] = compile_events(warm_cluster)[-1:]
+
+        # --- standby arm: promote parked precompiled spares -----------
+        standby: list[float] = []
+        for _ in range(2):
+            warm_cluster.ensure_standbys(1)
+            deadline = time.time() + 300.0
+            while time.time() < deadline:
+                st = warm_cluster.status()
+                if any(sb["ready"] for sb in st.get("standbys", [])):
+                    break
+                time.sleep(0.5)
+            else:
+                raise RuntimeError("standby never reached ready")
+            warm_cluster.kill_all(worker="0")
+            if not warm_cluster.promote_standby(0):
+                raise RuntimeError("promote_standby found no ready spare")
+            anchor = warm_cluster.status()["workers"][0]["spawned_at"]
+            standby.append(first_step_after(warm_cluster, anchor,
+                                            keep_log=True))
+            warm_cluster.kill_all()
+        warm_cluster.delete()
+
+        warm_median = statistics.median(warm) if warm else None
+        standby_median = statistics.median(standby)
+        warm_ratio = (round(warm_median / cold_median, 3)
+                      if warm_median is not None else None)
+        standby_ratio = round(standby_median / cold_median, 3)
+        warm_ok = None if warm_skipped else bool(warm_ratio <= 0.6)
+        standby_ok = bool(standby_ratio <= 0.3)
+        detail.update({
+            "gate": "warm ≤ 0.6× cold median, standby ≤ 0.3× cold median",
+            "cold_s": [round(t, 2) for t in cold],
+            "cold_median_s": round(cold_median, 2),
+            "prime_s": round(prime, 2),
+            "warm_s": [round(t, 2) for t in warm],
+            "warm_median_s": (round(warm_median, 2)
+                              if warm_median is not None else None),
+            "standby_s": [round(t, 2) for t in standby],
+            "standby_median_s": round(standby_median, 2),
+            "warm_ratio_vs_cold": warm_ratio,
+            "standby_ratio_vs_cold": standby_ratio,
+            "warm_gate_ok": warm_ok,
+            "standby_gate_ok": standby_ok,
+        })
+        if warm_skipped:
+            detail["warm_skipped"] = warm_skipped
+        passes = standby_ok and (warm_ok is not False)
+        return {"metric": "restart_latency",
+                "value": warm_ratio if warm_ratio is not None
+                else standby_ratio,
+                "unit": "x (restart first-moved-step vs cold median)",
+                "passes_gate": bool(passes),
+                "detail": detail}
+    finally:
+        # an error mid-arm must not leak detached worker/standby
+        # processes (start_new_session survives us; a parked standby
+        # whose activation dir vanished would spin forever) — kill
+        # every cluster this run created before removing its workdir
+        for c in clusters:
+            try:
+                c.kill_all()
+                c.exec.close()
+            except Exception:
+                pass
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_input_pipeline_overlap() -> dict:
     """Dispatch-ahead input pipeline: a deliberately slow host loader
     feeding the flagship CNN step, sync-feed (next → device_put →
@@ -796,7 +987,8 @@ def main() -> None:
     cases: list[dict] = []
     for case in (bench_transformer_flash, bench_flash_long_context,
                  bench_mode_overhead, bench_native_loader,
-                 bench_input_pipeline_overlap, bench_weight_update_sharding):
+                 bench_input_pipeline_overlap, bench_weight_update_sharding,
+                 bench_restart_latency):
         if not want(case):
             continue
         try:
@@ -829,8 +1021,23 @@ def main() -> None:
                           else None if c.get("vs_baseline") is None
                           else bool(c["vs_baseline"] >= 0.9))}
                   for c in guarded]}
+    # compile time as a first-class artifact metric (ROADMAP item 5):
+    # every case already measures its compile_s — surface them in one
+    # place, headline_regression_guard-style, so a compile-cache or
+    # lowering regression shows up in the bench JSON trajectory
+    # instead of hiding inside per-case detail
+    compile_seconds = {
+        "note": ("per-case XLA compile wall seconds; compare across "
+                 "BENCH_r* rounds — a jump here is a compile/lowering "
+                 "or persistent-cache regression even when throughput "
+                 "holds"),
+        "by_case": {c.get("metric"): c["detail"]["compile_s"]
+                    for c in [headline] + cases
+                    if isinstance(c.get("detail"), dict)
+                    and c["detail"].get("compile_s") is not None}}
     print(json.dumps({**headline, "cases": cases,
-                      "headline_regression_guard": guard},
+                      "headline_regression_guard": guard,
+                      "compile_seconds": compile_seconds},
                      separators=(",", ":")))
 
 

@@ -225,6 +225,51 @@ class ParallelConfig:
 
 
 @dataclass(frozen=True)
+class CompileConfig:
+    """Restart-latency fast path (ROADMAP item 5): persistent XLA
+    compilation cache + ahead-of-time train-step compilation.
+
+    Every supervisor restart and chaos trial used to pay the full XLA
+    compile (~10 s) on top of process boot; these knobs let a restarted
+    worker reuse its predecessor's compiles.
+
+    ``cache_dir``: where jax's persistent compilation cache lives. ""
+    resolves the ``DMT_COMPILE_CACHE_DIR`` env var (how
+    ``LocalProcessCluster`` threads ONE shared cache dir into every
+    worker it spawns) and disables the cache when that is unset too —
+    so plain library use is unchanged unless a dir is provided.
+    The global jax cache is only ENABLED at process entry points
+    (launch CLI, ``__graft_entry__``) — never from inside the Trainer:
+    on jaxlib 0.4.37 a process that builds several Trainers against an
+    enabled cache corrupts itself (measured). Library callers wanting
+    it call ``core.compile_cache.enable_persistent_cache`` once at
+    startup; the Trainer itself only uses the dir for the AOT
+    executable cache below.
+
+    ``precompile``: Trainer AOT-compiles the train step
+    (``jit(...).lower(...).compile()``) BEFORE the first batch, so
+    compile time is journaled separately from step time (the
+    ``event: "compile"`` record in train_log.jsonl) and a warm standby
+    can park fully compiled.
+
+    ``aot_executable_cache``: additionally serialize the compiled
+    train-step executable into ``<cache_dir>/aot`` keyed on
+    (model, config, topology) where the installed jax/backend supports
+    cross-process executable serialization. Platforms that don't (the
+    CPU backend raises "Symbols not found" on a foreign executable)
+    discover it on first load, journal the fallback, and lean on the
+    persistent compilation cache instead — measured, not assumed.
+    """
+
+    persistent_cache: bool = True
+    cache_dir: str = ""
+    min_entry_size_bytes: int = 0
+    min_compile_time_secs: float = 0.0
+    precompile: bool = True
+    aot_executable_cache: bool = True
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh topology. Replaces ClusterSpec/ps_hosts/worker_hosts
     (src/mnist_distributed_train.py:25-31, src/distributed_train.py:41-48)."""
@@ -326,6 +371,7 @@ class ExperimentConfig:
     sync: SyncConfig = field(default_factory=SyncConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    compile: CompileConfig = field(default_factory=CompileConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     eval: EvalConfig = field(default_factory=EvalConfig)
 
@@ -399,6 +445,7 @@ _SECTION_TYPES = {
     ("ExperimentConfig", "sync"): SyncConfig,
     ("ExperimentConfig", "mesh"): MeshConfig,
     ("ExperimentConfig", "parallel"): ParallelConfig,
+    ("ExperimentConfig", "compile"): CompileConfig,
     ("ExperimentConfig", "train"): TrainConfig,
     ("ExperimentConfig", "eval"): EvalConfig,
 }

@@ -37,14 +37,54 @@ def _load_cfg_and_bringup(args):
         simulate_devices(cfg.mesh.simulate_devices)
     else:
         initialize_distributed()  # multi-host bring-up before backend init
+    # persistent compile cache (cfg.compile / DMT_COMPILE_CACHE_DIR):
+    # a restarted worker reuses its predecessor's compiles instead of
+    # paying the full XLA compile again on every recovery
+    from ..core.compile_cache import enable_persistent_cache
+    enable_persistent_cache(cfg.compile)
     return cfg
 
 
+def _park_standby(trainer, activation: str) -> None:
+    """The warm-standby protocol (ROADMAP item 5): precompile, signal
+    readiness by touching ``<activation>.ready``, then PARK until the
+    supervisor's promotion writes the activation file (atomic rename —
+    never read torn) naming the dead worker's train_dir, and adopt it.
+    The parked process has already paid import, mesh bring-up and the
+    train-step compile, so promotion→first-moved-step is data-path
+    time only."""
+    import json as _json
+    import os as _os
+    import time as _time
+    from pathlib import Path
+
+    try:
+        trainer.precompile()
+    except Exception as e:  # park anyway: a warm PROCESS still beats a
+        # cold boot even if the compile must happen at first step
+        print(f"standby precompile failed ({type(e).__name__}: {e}); "
+              "parking warm-process only", file=sys.stderr)
+    act = Path(activation)
+    act.parent.mkdir(parents=True, exist_ok=True)
+    ready = act.with_name(act.name + ".ready")
+    ready.write_text(_json.dumps({"pid": _os.getpid(),
+                                  "ready_at": _time.time()}))
+    while not act.exists():
+        _time.sleep(0.1)
+    assignment = _json.loads(act.read_text())
+    trainer.adopt_train_dir(assignment["train_dir"])
+
+
 def _train(args) -> None:
+    import os
+
     cfg = _load_cfg_and_bringup(args)
     from ..train.loop import Trainer
 
     trainer = Trainer(cfg)
+    activation = os.environ.get("DMT_STANDBY_ACTIVATION")
+    if activation:
+        _park_standby(trainer, activation)
     summary = trainer.run()
     if summary.get("preempted"):
         # a flushed, resumable stop (SIGTERM/SIGINT mid-run): exit with

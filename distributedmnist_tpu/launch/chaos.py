@@ -253,7 +253,22 @@ class ChaosConfig:
     max_restarts: int = 2
     restart_backoff_s: float = 0.3
     stall_timeout_s: float | None = None  # None = per-payload default
+    standby_workers: int = 0              # pre-booted spares per trial
     poll_secs: float | None = None        # None = per-payload default
+    # One persistent compile cache shared by the reference run and
+    # every trial (<campaign root>/compile_cache): the reference pays
+    # the cold compile once and every later worker boot — including
+    # every restart the faults force — is warm. What makes the
+    # boot-derived stall timeout below safe.
+    share_compile_cache: bool = True
+    # Adaptive stall timeout (train payload): once a run has MEASURED
+    # its spawn→first-log boot cost, trials stop paying the hardcoded
+    # 90 s worst case — detection drops to
+    # max(floor, mult × measured_boot), still capped at 90 s. The
+    # floor keeps a noisy fast measurement from turning boot jitter
+    # into false hang detections.
+    stall_timeout_floor_s: float = 20.0
+    stall_timeout_boot_mult: float = 3.0
     trial_timeout_s: float = 900.0
     drain_timeout_s: float = 180.0
     # drain gives up early on live workers whose logs stop moving for
@@ -281,14 +296,25 @@ class ChaosConfig:
         return self.poll_secs if self.poll_secs is not None else (
             0.2 if self.payload == "shell" else 1.0)
 
-    def resolved_stall_timeout_s(self) -> float:
+    def resolved_stall_timeout_s(self,
+                                 measured_boot_s: float | None = None
+                                 ) -> float:
         if self.stall_timeout_s is not None:
             return self.stall_timeout_s
+        if self.payload == "shell":
+            return 2.5
         # the stall clock starts at the first poll, BEFORE the worker
-        # has logged anything — a real jax worker spends ~15-30 s
-        # booting, so the train-payload timeout must clear a full boot
-        # or healthy boots read as hangs
-        return 2.5 if self.payload == "shell" else 90.0
+        # has logged anything — the timeout must clear a full boot or
+        # healthy boots read as hangs. With a MEASURED boot cost
+        # (the reference run's spawn→first-log latency) the default
+        # derives from reality instead of the hardcoded worst case: a
+        # warm-cache boot of ~5 s detects a stalled worker in ~20 s,
+        # not 90.
+        if measured_boot_s is not None and measured_boot_s > 0:
+            return min(90.0, max(self.stall_timeout_floor_s,
+                                 self.stall_timeout_boot_mult
+                                 * measured_boot_s))
+        return 90.0
 
     def resolved_stall_ms_range(self) -> tuple[float, float]:
         if self.stall_ms_range is not None:
@@ -323,20 +349,32 @@ class ChaosCampaign:
     def __init__(self, cfg: ChaosConfig):
         self.cfg = cfg
         self.reference_dir: Path | None = None
+        # latest observed spawn→first-log cost (reference first, then
+        # each completed trial): what resolved_stall_timeout_s derives
+        # the trial detection window from
+        self._measured_boot_s: float | None = None
 
     # -- one trial ------------------------------------------------------
 
     def _run_trial(self, rel: str, plan: FaultPlan, seed: int,
-                   num_workers: int) -> dict[str, Any]:
+                   num_workers: int,
+                   measured_boot_s: float | None = None) -> dict[str, Any]:
         """Execute one supervised run under ``plan`` in
         ``<root>/<rel>``; returns the outcome record (also written to
         ``outcome.json`` there so the invariant replay is
-        artifact-only)."""
+        artifact-only). ``measured_boot_s``: a previous run's observed
+        spawn→first-log cost — lets the stall timeout derive from the
+        measured boot instead of the hardcoded worst case."""
         cfg = self.cfg
         target = cfg.until_step
         lcfg = LocalClusterConfig(
             name=rel, num_workers=num_workers, workdir=str(cfg.root),
-            train_command=cfg.resolved_train_command())
+            train_command=cfg.resolved_train_command(),
+            # ONE cache for the whole campaign, not per-trial: the
+            # reference's cold compile warms every later boot
+            compile_cache=cfg.share_compile_cache,
+            compile_cache_dir=(str(cfg.root / "compile_cache")
+                               if cfg.share_compile_cache else ""))
         executor = CommandExecutor(
             journal=lcfg.root / "command_journal.jsonl",
             retry=RetryPolicy(max_attempts=1, seed=seed),
@@ -346,7 +384,8 @@ class ChaosCampaign:
             quorum=min(cfg.quorum, num_workers),
             max_restarts_per_worker=cfg.max_restarts,
             restart_backoff_s=cfg.restart_backoff_s,
-            stall_timeout_s=cfg.resolved_stall_timeout_s(),
+            stall_timeout_s=cfg.resolved_stall_timeout_s(measured_boot_s),
+            standby_workers=cfg.standby_workers,
             seed=seed)
         sup = ClusterSupervisor(cluster, scfg)
         outcome: dict[str, Any] = {
@@ -368,9 +407,16 @@ class ChaosCampaign:
             got = sup.supervise_until_step(
                 target, poll_secs=cfg.resolved_poll_secs(),
                 timeout_secs=cfg.trial_timeout_s)
-            outcome.update(outcome="completed", step=got["step"],
-                           recovery=got.get("recovery"))
-            self._drain(cluster)
+            outcome.update(outcome="completed", step=got["step"])
+            self._drain(cluster, sup)
+            # the drain may have closed recovery episodes the
+            # supervised loop left open (a worker restarted near
+            # run-end finishes its jax boot DURING the drain) — the
+            # outcome's recovery/MTTR summary must include them
+            outcome["recovery"] = sup.summary()
+            # spawn→first-log cost of THIS run's workers: the adaptive
+            # stall timeout for later trials derives from it
+            outcome["boot_s"] = cluster.measured_boot_s()
         except ClusterError as e:
             aborted = any(ev.get("action") == "below_quorum_abort"
                           for ev in sup.events)
@@ -401,7 +447,57 @@ class ChaosCampaign:
         except OSError:
             return False  # no log at all yet: definitely still booting
 
-    def _drain(self, cluster: LocalProcessCluster) -> None:
+    @staticmethod
+    def _resumed_step_since_spawn(worker: dict
+                                  ) -> tuple[int, float | None] | None:
+        """``(step, record_time)`` to close this worker's recovery
+        episode with, or None if it has not provably resumed. Log
+        mtime moving since the worker's own (re)spawn is necessary but
+        NOT sufficient: a restarted trainer journals its ``event:
+        "compile"`` record before its first step, and an adopted logdir
+        still carries the previous incarnation's step records — closing
+        on either would journal a resume with a stale step and count a
+        worker that wedged right after boot as recovered. Only the
+        newest intact record being a STEP record (appended since spawn,
+        so it is this incarnation's) is a first-moved-step; its own
+        ``time`` stamp (when the step happened, vs when this sweep
+        observed it) is what MTTR closes on."""
+        if not ChaosCampaign._logged_since_spawn(worker):
+            return None
+        log = Path(worker["logdir"]) / "train_log.jsonl"
+        try:
+            with open(log, "rb") as fh:
+                fh.seek(0, 2)
+                fh.seek(max(0, fh.tell() - 8192))
+                lines = fh.read().decode("utf-8", "replace").splitlines()
+        except OSError:
+            return None
+        for ln in reversed(lines):
+            if not ln.strip():
+                continue
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                # torn newest write: the next-intact record behind it
+                # may belong to the PREVIOUS incarnation (the torn line
+                # is what moved the mtime) — closing on it would
+                # journal a stale-step resume. Wait for the line to
+                # complete on a later tick; a worker killed mid-append
+                # stays open and is counted in unrecovered.
+                return None
+            if not isinstance(rec, dict):
+                return None
+            if rec.get("event", "step") != "step":
+                return None  # newest intact record: compile, not a step
+            step = rec.get("step")
+            if not isinstance(step, int):
+                return None
+            t = rec.get("time")
+            return step, (t if isinstance(t, (int, float)) else None)
+        return None
+
+    def _drain(self, cluster: LocalProcessCluster,
+               sup: ClusterSupervisor | None = None) -> None:
         """The supervisor returns when the FASTEST worker hits the
         target; wait for the rest to finish their final save and exit
         before teardown, or the determinism check would compare
@@ -418,17 +514,37 @@ class ChaosCampaign:
         (> drain_stall_s) producing no log movement, and the old global
         clock would kill it mid-boot — silently downgrading the trial
         to determinism-skipped (PR 4's known rough edge). A worker that
-        never logs at all is still bounded by drain_timeout_s."""
+        never logs at all is still bounded by drain_timeout_s.
+
+        With ``sup``, the drain also CLOSES recovery episodes the
+        supervised loop left open: a worker restarted near run-end
+        finishes its jax boot here, and the tick its first STEP record
+        since its own spawn lands is its first-moved-step (the compile
+        record alone is not a resume — see _resumed_step_since_spawn) —
+        the ``resume`` event (with MTTR) would otherwise never be
+        journaled and the trial would undercount its episodes."""
         deadline = time.monotonic() + self.cfg.drain_timeout_s
         stall_window = self.cfg.drain_stall_s
         last_progress: dict[int, Any] = {}
         moved_at: dict[int, float] = {}
         while time.monotonic() < deadline:
             st = cluster.status()
+            if st is not None and sup is not None and sup.open_episodes:
+                # swept BEFORE the all-dead return: a restarted worker
+                # that resumed, finished, and exited between supervise
+                # and the first drain tick still closes its episode
+                for w in st["workers"]:
+                    if w["worker"] in sup.open_episodes:
+                        resumed = self._resumed_step_since_spawn(w)
+                        if resumed is not None:
+                            sup.close_episode(w["worker"], *resumed)
             if st is None or not any(w["alive"] for w in st["workers"]):
                 return
-            now = time.monotonic()
+            # below the all-dead return: the stall loop is prog's only
+            # consumer, and every drain ends through that return — the
+            # final tick must not pay the per-worker tail sweep
             prog = cluster.worker_progress()
+            now = time.monotonic()
             stalled: list[bool] = []
             for w in st["workers"]:
                 if not w["alive"]:
@@ -473,6 +589,16 @@ class ChaosCampaign:
                 f"{ref.get('error', ref['outcome'])} — no baseline to "
                 "judge trials against")
         self.reference_dir = cfg.root / "reference" / "worker0"
+        # the reference's measured boot (cold compile into the shared
+        # cache) drives every trial's stall timeout; trials re-measure,
+        # so warm boots keep tightening it
+        self._measured_boot_s = ref.get("boot_s")
+        if self._measured_boot_s:
+            logger.info(
+                "chaos: reference boot %.1fs → trial stall timeout %.1fs "
+                "(was %.1fs un-measured)", self._measured_boot_s,
+                cfg.resolved_stall_timeout_s(self._measured_boot_s),
+                cfg.resolved_stall_timeout_s())
 
         reproducer: dict[str, Any] | None = None
         for t in range(cfg.trials):
@@ -484,7 +610,12 @@ class ChaosCampaign:
                         schedule.describe())
             rel = f"trial{t:03d}"
             outcome = self._run_trial(rel, schedule.to_fault_plan(),
-                                      cfg.seed, cfg.num_workers)
+                                      cfg.seed, cfg.num_workers,
+                                      measured_boot_s=self._measured_boot_s)
+            if outcome.get("boot_s"):
+                # warm boots keep tightening (never loosening past the
+                # cap) the next trial's detection window
+                self._measured_boot_s = outcome["boot_s"]
             check = check_run(cfg.root / rel, outcome=outcome,
                               reference_dir=self.reference_dir)
             rec = {"event": "chaos_trial", "trial": t, "seed": cfg.seed,
@@ -493,6 +624,13 @@ class ChaosCampaign:
                    "outcome": outcome["outcome"], "step": outcome.get("step"),
                    "target": cfg.until_step,
                    "duration_s": outcome["duration_s"],
+                   # per-trial MTTR: detect→first-moved-step per episode
+                   # (summarize_recovery_events), the chaos report's
+                   # first-class recovery-latency metric
+                   "mttr": (outcome.get("recovery") or {}).get("mttr"),
+                   "boot_s": outcome.get("boot_s"),
+                   "stall_timeout_s": (outcome.get("supervisor") or {})
+                   .get("stall_timeout_s"),
                    "verdicts": check["verdicts"],
                    "violations": check["violations"]}
             if check["violations"] and cfg.shrink and reproducer is None:
@@ -528,7 +666,8 @@ class ChaosCampaign:
             probes[0] += 1
             logger.info("shrink probe %s: %s", rel, cand.describe())
             outcome = self._run_trial(rel, cand.to_fault_plan(), cfg.seed,
-                                      cfg.num_workers)
+                                      cfg.num_workers,
+                                      measured_boot_s=self._measured_boot_s)
             got = check_run(cfg.root / rel, outcome=outcome,
                             reference_dir=self.reference_dir)
             return bool({v["invariant"] for v in got["violations"]}

@@ -51,12 +51,14 @@ def parse_poll_output(text: str | None) -> dict[str, Any]:
     """Parse the tail of a ``train_log.jsonl`` into {"step", "record"}.
 
     Scans BACKWARDS past a torn/non-JSON final line to the last intact
-    record: the writer may be mid-append when the tail runs, and
+    STEP record: the writer may be mid-append when the tail runs, and
     reporting step -1 for a whole poll tick makes live progress look
     stalled — which a supervisor's ``stall_timeout_s`` could misread as
-    a hang. step is -1 only when no intact record exists at all (run
-    still booting, or the tail window held nothing but torn lines —
-    the next poll resolves it).
+    a hang. Intact non-step records (the ``event: "compile"`` line a
+    precompiling worker appends before its first step) are skipped the
+    same way: they are liveness, not regression to -1. step is -1 only
+    when no step record exists at all (run still booting, or the tail
+    window held nothing usable — the next poll resolves it).
     """
     for line in reversed((text or "").strip().splitlines()):
         line = line.strip()
@@ -66,7 +68,9 @@ def parse_poll_output(text: str | None) -> dict[str, Any]:
             record = json.loads(line)
         except json.JSONDecodeError:
             continue  # torn write — keep scanning backwards
-        return {"step": int(record.get("step", -1)), "record": record}
+        if "step" not in record:
+            continue  # compile/other event record — not a step reading
+        return {"step": int(record["step"]), "record": record}
     return {"step": -1, "record": None}
 
 
@@ -311,6 +315,20 @@ class LocalClusterConfig:
         "data.synthetic_train_size=256 data.synthetic_test_size=64 "
         "model.compute_dtype=float32 train.max_steps=50 "
         "train.log_every_steps=5 train.save_interval_steps=0")
+    # Warm standbys (ROADMAP item 5): the command a PRE-BOOTED spare
+    # process runs — it must honor the DMT_STANDBY_ACTIVATION protocol
+    # (boot, precompile, touch <activation>.ready, park until the
+    # activation file appears, then adopt the assigned logdir). "" =
+    # train_command, which `launch train` realizes natively.
+    standby_command: str = ""
+    # One SHARED persistent compile cache threaded into every worker's
+    # env (DMT_COMPILE_CACHE_DIR): a restarted worker hits warm
+    # compiles from its predecessor's run instead of paying the full
+    # XLA compile again. "" = <root>/compile_cache; disable with
+    # compile_cache=false. An explicit DMT_COMPILE_CACHE_DIR in
+    # cfg.env still wins.
+    compile_cache: bool = True
+    compile_cache_dir: str = ""
     env: dict[str, str] = dataclasses.field(default_factory=dict)
 
     @classmethod
@@ -328,6 +346,18 @@ class LocalClusterConfig:
 
     def worker_dir(self, k: int) -> Path:
         return self.root / f"worker{k}"
+
+    def standby_dir(self, j: int) -> Path:
+        return self.root / f"standby{j}"
+
+    def resolved_compile_cache_dir(self) -> Path | None:
+        if not self.compile_cache:
+            return None
+        return (Path(self.compile_cache_dir) if self.compile_cache_dir
+                else self.root / "compile_cache")
+
+    def resolved_standby_command(self) -> str:
+        return self.standby_command or self.train_command
 
 
 class LocalProcessCluster(ClusterBackend):
@@ -431,6 +461,19 @@ class LocalProcessCluster(ClusterBackend):
         env["PYTHONPATH"] = os.pathsep.join(
             [repo_root] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH")
                            else []))
+        cache = self.cfg.resolved_compile_cache_dir()
+        if cache is not None:
+            # the shared warm-compile seam: every worker (and standby)
+            # of this cluster reads/writes ONE persistent compile cache
+            env["DMT_COMPILE_CACHE_DIR"] = str(cache)
+        else:
+            # compile_cache=false must mean COLD: an inherited ambient
+            # cache dir (the bench's cold arm runs in the same shell
+            # that exported it) would silently warm every "cold"
+            # worker. jax reads its own env var at import, with no
+            # enable_persistent_cache call needed, so it must go too.
+            env.pop("DMT_COMPILE_CACHE_DIR", None)
+            env.pop("JAX_COMPILATION_CACHE_DIR", None)
         env.update(self.cfg.env)
         env.update({"DMT_WORKER_INDEX": str(k),
                     "DMT_NUM_WORKERS": str(self.cfg.num_workers),
@@ -517,6 +560,177 @@ class LocalProcessCluster(ClusterBackend):
         state["phase"] = "running"
         self._write_state(state)
 
+    # -- warm standbys (ROADMAP item 5) ---------------------------------
+
+    def _spawn_standby(self, state: dict[str, Any]) -> dict[str, Any]:
+        """Spawn ONE pre-booting spare process: it runs the standby
+        command with ``DMT_STANDBY_ACTIVATION`` pointing at its own
+        activation file, boots jax, precompiles, touches
+        ``<activation>.ready`` and parks. Returns the standby record
+        (appended to ``state["standbys"]``); the caller writes state."""
+        slots = state.setdefault("standbys", [])
+        # monotonic id from a state-level sequence — NOT max(live slots):
+        # a back-fill after a promotion must never reuse a consumed
+        # standby's dir, where a stale activation file would instantly
+        # (and wrongly) activate the fresh spare onto the old assignment
+        j = state.get("standby_seq", 0)
+        state["standby_seq"] = j + 1
+        sdir = self.cfg.standby_dir(j)
+        sdir.mkdir(parents=True, exist_ok=True)
+        activation = sdir / "activate.json"
+        # stale protocol files from a previous cluster incarnation in
+        # the same workdir would likewise fire the protocol early
+        activation.unlink(missing_ok=True)
+        Path(str(activation) + ".ready").unlink(missing_ok=True)
+        env = self._worker_env(0)
+        env.pop("DMT_WORKER_INDEX", None)
+        env.pop("DMT_WORKER_DIR", None)
+        env["DMT_STANDBY_ACTIVATION"] = str(activation)
+        log_fh = open(sdir / "standby_stdout.log", "ab")
+        try:
+            proc = subprocess.Popen(
+                ["sh", "-c", self.cfg.resolved_standby_command()],
+                cwd=sdir, env=env, stdout=log_fh,
+                stderr=subprocess.STDOUT, start_new_session=True)
+        finally:
+            log_fh.close()
+        sb = {"standby": j, "pid": proc.pid, "dir": str(sdir),
+              "activation": str(activation), "spawned_at": time.time()}
+        slots.append(sb)
+        self.exec.journal({"event": "spawn", "standby": j, "pid": proc.pid,
+                           "command": self.cfg.resolved_standby_command()})
+        return sb
+
+    def _standby_ready(self, sb: dict[str, Any]) -> bool:
+        """Parked and promotable: the process signalled ready (it has
+        imported jax, built its trainer, precompiled) and is alive."""
+        marker = Path(sb["activation"] + ".ready")
+        return (marker.exists() and bool(sb.get("pid"))
+                and self._pid_alive(sb["pid"]))
+
+    def ensure_standbys(self, n: int) -> None:
+        """Top the warm-standby pool up to ``n`` live spares. Spawning
+        is async (the spare boots in the background); only a spare that
+        reached its ready marker is promotable."""
+        state = self._read_state()
+        if not state["workers"]:
+            raise ClusterError("ensure_standbys before create: no workers")
+        if self.exec.dry_run:
+            for _ in range(n):
+                self.exec.run(["sh", "-c",
+                               self.cfg.resolved_standby_command()],
+                              verb="run")
+            return
+        slots = state.setdefault("standbys", [])
+        dead = [sb for sb in slots
+                if not (sb.get("pid") and self._pid_alive(sb["pid"]))]
+        for sb in dead:
+            slots.remove(sb)
+            self.exec.journal({"event": "lifecycle",
+                               "action": "standby_reaped",
+                               "standby": sb["standby"], "pid": sb.get("pid")})
+        for _ in range(max(0, n - len(slots))):
+            self._spawn_standby(state)
+        self._write_state(state)
+
+    def promote_standby(self, k: int) -> bool:
+        """Hand worker ``k``'s identity to a READY standby: kill any
+        previous incarnation, write the activation file (atomically, so
+        the parked process never reads a torn assignment), and record
+        the standby's pid as the worker's. Returns False — caller falls
+        back to a cold ``restart_worker`` — when no standby is ready.
+
+        The worker's ``spawned_at`` is stamped with the PROMOTION time:
+        per-incarnation clocks (the chaos drain's stall parking) must
+        measure from when this process took over the logdir, not from
+        when the spare originally booted — its old log silence was
+        parking, not stalling."""
+        if self.exec.dry_run:
+            return False
+        state = self._read_state()
+        sel = self._select(state["workers"], str(k))
+        if not sel:
+            raise ClusterError(f"promote_standby({k}): no such worker")
+        w = sel[0]
+        ready = [sb for sb in state.get("standbys", [])
+                 if self._standby_ready(sb)]
+        if not ready:
+            return False
+        sb = ready[0]
+        if w.get("pid"):
+            self._kill_pid(w["pid"], "kill")
+        activation = Path(sb["activation"])
+        tmp = activation.with_suffix(".tmp")
+        tmp.write_text(json.dumps({"train_dir": w["logdir"], "worker": k}))
+        tmp.replace(activation)
+        state["standbys"].remove(sb)
+        w["pid"] = sb["pid"]
+        w["spawned_at"] = time.time()
+        w["promoted_from_standby"] = sb["standby"]
+        state["phase"] = "running"
+        # The activation file above is the commit point: the parked
+        # process is already adopting worker k's logdir, so EVERYTHING
+        # below is best-effort — an exception escaping here reads as
+        # promoted=False to the supervisor, which would cold-respawn a
+        # second trainer into the train_dir the live standby now owns.
+        try:
+            self._write_state(state)
+            self.exec.journal({"event": "lifecycle",
+                               "action": "promote_standby",
+                               "worker": k, "standby": sb["standby"],
+                               "pid": sb["pid"]})
+        except Exception as e:
+            logger.warning("promotion bookkeeping failed (%s: %s) — "
+                           "promotion stands", type(e).__name__, e)
+        # back-fill asynchronously: the pool heals while the promoted
+        # process is already training; a failed spawn (fork/fd
+        # pressure) must not unwind the promotion either.
+        try:
+            self._spawn_standby(state)
+            self._write_state(state)
+        except Exception as e:
+            logger.warning("standby back-fill failed (%s) — pool not "
+                           "replenished", e)
+            try:
+                self.exec.journal({"event": "lifecycle",
+                                   "action": "standby_backfill_failed",
+                                   "error": str(e)})
+            except Exception:
+                pass
+        return True
+
+    def measured_boot_s(self) -> float | None:
+        """Observed spawn→first-log-record latency (max over workers
+        whose first intact record postdates their recorded spawn) —
+        what adaptive stall timeouts derive from instead of the
+        hardcoded worst case. None when nothing measurable yet (no
+        logs, records without timestamps, or logs predating the
+        current incarnation)."""
+        state = self._read_state()
+        out: list[float] = []
+        for w in state["workers"]:
+            spawned = w.get("spawned_at")
+            if not spawned:
+                continue
+            log = Path(w["logdir"]) / "train_log.jsonl"
+            try:
+                with open(log) as fh:
+                    for line in fh:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            rec = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue
+                        t = rec.get("time")
+                        if isinstance(t, (int, float)) and t >= spawned:
+                            out.append(t - spawned)
+                        break  # first intact record decides
+            except OSError:
+                continue
+        return max(out) if out else None
+
     def _select(self, workers: list[dict], worker: str) -> list[dict]:
         if worker == "all":
             return workers
@@ -538,6 +752,12 @@ class LocalProcessCluster(ClusterBackend):
         for w in self._select(state["workers"], worker):
             if w.get("pid"):
                 self._kill_pid(w["pid"], "kill")
+        if worker == "all":
+            # parked spares die with the cluster — a standby that
+            # outlives its run would hold jax memory forever
+            for sb in state.get("standbys", []):
+                if sb.get("pid"):
+                    self._kill_pid(sb["pid"], "kill")
 
     def status(self) -> dict[str, Any] | None:
         """pgrep-equivalent liveness per worker — a REAL ``kill -0``
@@ -556,9 +776,17 @@ class LocalProcessCluster(ClusterBackend):
             workers.append({"worker": w["worker"], "pid": w.get("pid"),
                             "alive": alive, "logdir": w["logdir"],
                             "spawned_at": w.get("spawned_at")})
-        return {"state": state["phase"].upper(),
-                "workers": workers,
-                "idle": not any(w["alive"] for w in workers)}
+        standbys = [{"standby": sb["standby"], "pid": sb.get("pid"),
+                     "alive": (bool(sb.get("pid"))
+                               and self._pid_alive(sb["pid"])),
+                     "ready": self._standby_ready(sb)}
+                    for sb in state.get("standbys", [])]
+        got = {"state": state["phase"].upper(),
+               "workers": workers,
+               "idle": not any(w["alive"] for w in workers)}
+        if standbys:
+            got["standbys"] = standbys
+        return got
 
     def exec_all(self, command: str, worker: str = "all") -> None:
         state = self._read_state()
@@ -812,6 +1040,10 @@ def main(argv: list[str] | None = None) -> None:
                    help="for supervise: base restart backoff")
     p.add_argument("--stall-timeout-s", type=float, default=None,
                    help="for supervise: hang detection window (0 = off)")
+    p.add_argument("--standby-workers", type=int, default=None,
+                   help="for supervise/chaos: keep N pre-booted, "
+                        "precompiled standby processes parked; a due "
+                        "restart promotes one instead of cold-starting")
     p.add_argument("--seed", type=int, default=None,
                    help="for supervise/chaos: schedule + retry-jitter "
                         "seed, stamped on every journaled recovery/chaos "
@@ -858,6 +1090,7 @@ def main(argv: list[str] | None = None) -> None:
                      "max_restarts": args.max_restarts,
                      "restart_backoff_s": args.restart_backoff_s,
                      "stall_timeout_s": args.stall_timeout_s,
+                     "standby_workers": args.standby_workers,
                      "poll_secs": args.poll_secs}
         ccfg = dataclasses.replace(
             ccfg, **{k: v for k, v in overrides.items() if v is not None})
@@ -902,6 +1135,7 @@ def main(argv: list[str] | None = None) -> None:
                      "max_restarts_per_worker": args.max_restarts,
                      "restart_backoff_s": args.restart_backoff_s,
                      "stall_timeout_s": args.stall_timeout_s,
+                     "standby_workers": args.standby_workers,
                      "seed": args.seed}
         scfg = dataclasses.replace(
             scfg, **{k: v for k, v in overrides.items() if v is not None})

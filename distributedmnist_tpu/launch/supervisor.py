@@ -68,6 +68,14 @@ class SupervisorConfig:
     # progress for this long (while the pid stays alive) is killed and
     # restarted under the same budget
     stall_timeout_s: float = 0.0
+    # Warm standbys (ROADMAP item 5): keep N pre-booted spare processes
+    # parked (imported jax, mesh up, train step precompiled) on
+    # backends that support them; a due restart PROMOTES a ready spare
+    # — handing it the dead worker's logdir to resume from — instead of
+    # cold-starting, and the pool back-fills asynchronously. Promotions
+    # ride the same per-worker restart budget (journaled as
+    # ``action: "restart", via: "standby"``). 0 = off.
+    standby_workers: int = 0
     # The run's schedule seed (chaos campaigns / `--seed`): stamped on
     # every recovery event so a journaled episode is replayable from
     # the artifact alone — the seed regenerates the fault schedule and
@@ -105,6 +113,17 @@ class ClusterSupervisor:
         self.cfg = cfg or SupervisorConfig()
         self.events: list[dict[str, Any]] = []
         self._restarts: dict[int, int] = {}
+        # open recovery episodes: restarted workers whose own log has
+        # not moved yet, plus the MTTR anchors (wall clock, matching
+        # event "time" stamps) their eventual resume closes with.
+        # Instance state, not supervise-locals: a run that reaches its
+        # target while a restarted worker is still mid-jax-boot leaves
+        # the episode OPEN, and the caller (the chaos drain — exactly
+        # the window where that worker finishes booting) closes it via
+        # close_episode so its MTTR is still journaled.
+        self._watch_resume: set[int] = set()
+        self._detect_t: dict[int, float] = {}
+        self._respawn_t: dict[int, float] = {}
 
     # -- event plumbing -------------------------------------------------
 
@@ -119,6 +138,49 @@ class ClusterSupervisor:
         ex = getattr(self.backend, "exec", None)
         if ex is not None and hasattr(ex, "journal"):
             ex.journal(rec)
+
+    def _mttr_fields(self, k: int, at: float | None = None
+                     ) -> dict[str, Any]:
+        """The detect→respawned→first-moved-step latencies a resume
+        event closes its recovery episode with — MTTR as a first-class
+        journal fact (obsv.journal.summarize_recovery_events computes
+        the percentiles). ``at`` is when the first moved step actually
+        HAPPENED where the caller knows it (the step record's own
+        timestamp) — observation time is quantized to the poll cadence
+        and would overstate short episodes by up to a whole tick."""
+        out: dict[str, Any] = {}
+        now = time.time() if at is None else at
+        if k in self._detect_t:
+            out["detected_at"] = self._detect_t[k]
+            out["mttr_s"] = round(now - self._detect_t[k], 3)
+        if k in self._respawn_t:
+            out["respawned_at"] = self._respawn_t[k]
+            out["resume_after_respawn_s"] = round(
+                now - self._respawn_t[k], 3)
+        return out
+
+    @property
+    def open_episodes(self) -> set[int]:
+        """Workers restarted during the last supervised run whose own
+        log has not been seen moving yet — episodes without a closing
+        ``resume`` event. Non-empty after a run that completed while a
+        restart was still booting; the caller closes them from its own
+        post-run observation window (:meth:`close_episode`)."""
+        return set(self._watch_resume)
+
+    def close_episode(self, k: int, step: int | None = None,
+                      at: float | None = None) -> None:
+        """Journal the ``resume`` that closes worker ``k``'s open
+        recovery episode — called by whoever observes the restarted
+        worker's first log movement AFTER the supervised loop returned
+        (the chaos drain). ``at``: the first moved step's own wall
+        timestamp when the caller holds the record (else observation
+        time). No-op for workers without an open episode, so callers
+        can sweep unconditionally."""
+        if k in self._watch_resume:
+            self._watch_resume.discard(k)
+            self._event("resume", worker=k, step=step,
+                        **self._mttr_fields(k, at))
 
     def summary(self) -> dict[str, Any]:
         """Aggregate this run's recovery episode — the SAME aggregation
@@ -148,11 +210,35 @@ class ClusterSupervisor:
         deadline = time.monotonic() + timeout_secs
         pending_restart: dict[int, float] = {}  # worker -> due monotonic
         exhausted: set[int] = set()
-        watch_resume: set[int] = set()  # restarted, awaiting log progress
         last_alive: int | None = None
         # hang detection state: last observed step + when it changed
         last_progress: dict[int, int] = {}
         last_progress_t: dict[int, float] = {}
+        # fresh episode state per supervised run (instance-level so a
+        # post-run caller can close episodes the run left open)
+        self._watch_resume = set()
+        self._detect_t = {}
+        self._respawn_t = {}
+        watch_resume = self._watch_resume
+
+        if (cfg.standby_workers > 0
+                and hasattr(self.backend, "ensure_standbys")):
+            # async: the spares boot jax + precompile in the background
+            # while the run proceeds; only READY spares get promoted.
+            # The pool is an OPTIMIZATION — a spawn failure (fork
+            # pressure under a chaos campaign, exhausted fds) must
+            # degrade to standby-less cold restarts, never abort the
+            # run the standbys exist to protect.
+            try:
+                self.backend.ensure_standbys(cfg.standby_workers)
+                self._event("standbys_requested",
+                            count=cfg.standby_workers)
+            except Exception as e:
+                logger.warning("could not provision standbys (%s: %s) — "
+                               "continuing without the warm pool",
+                               type(e).__name__, e)
+                self._event("standbys_unavailable",
+                            error=f"{type(e).__name__}: {e}")
 
         def schedule_restart(k: int, now: float) -> None:
             """Shared dead/hung bookkeeping: a worker entering recovery
@@ -184,16 +270,42 @@ class ClusterSupervisor:
             progress = got.get("worker_progress")
             if progress is None and can_progress:
                 progress = self.backend.worker_progress()
+            now = time.monotonic()
+            # ---- per-worker log movement: resume attribution ----------
+            # BEFORE the target check: an episode whose restarted
+            # worker's log moves on the very tick the run completes
+            # must still get its closing resume (and MTTR) journaled
+            moved: set[int] = set()
+            if progress is not None:
+                for k, step_k in progress.items():
+                    if step_k != last_progress.get(k):
+                        last_progress[k] = step_k
+                        last_progress_t[k] = now
+                        moved.add(k)
+                        if k in watch_resume and step_k >= 0:
+                            # the restarted worker's own log moved: THIS
+                            # step (not worker 0's) is where it resumed
+                            self.close_episode(k, step_k)
             best_step = got["step"]
             if progress:
                 best_step = max(best_step, *progress.values())
             if best_step >= target:
+                if progress is None and watch_resume:
+                    # no per-worker log signal on this backend: a
+                    # restarted worker that shows alive at completion
+                    # counts as resumed (same rule as the in-run
+                    # fallback below)
+                    final = got.get("workers")
+                    if final is None:
+                        final = (self.backend.status() or {}).get(
+                            "workers", [])
+                    for w in final:
+                        if w.get("alive"):
+                            self.close_episode(w["worker"], got["step"])
                 self._event("target_reached", step=best_step)
                 got["step"] = best_step
                 got["recovery"] = self.summary()
                 return got
-
-            now = time.monotonic()
             # reuse the liveness snapshot poll() already took this tick
             # (LocalProcessCluster attaches it); only backends that
             # don't get the separate status() sweep
@@ -207,26 +319,21 @@ class ClusterSupervisor:
             for k, is_alive in alive.items():
                 if is_alive or k in pending_restart or k in exhausted:
                     continue
+                self._detect_t[k] = time.time()
                 self._event("detect", worker=k, at_step=got["step"],
                             kind="dead")
                 schedule_restart(k, now)
 
-            # ---- per-worker log movement: resume attribution + hangs --
+            # ---- hang detection over workers whose log did NOT move --
             if progress is not None:
                 for k, step_k in progress.items():
-                    if step_k != last_progress.get(k):
-                        last_progress[k] = step_k
-                        last_progress_t[k] = now
-                        if k in watch_resume and step_k >= 0:
-                            # the restarted worker's own log moved: THIS
-                            # step (not worker 0's) is where it resumed
-                            watch_resume.discard(k)
-                            self._event("resume", worker=k, step=step_k)
-                    elif (cfg.stall_timeout_s > 0
-                          and alive.get(k) and k not in pending_restart
-                          and k not in exhausted
-                          and now - last_progress_t.get(k, now)
-                          >= cfg.stall_timeout_s):
+                    if (k not in moved
+                            and cfg.stall_timeout_s > 0
+                            and alive.get(k) and k not in pending_restart
+                            and k not in exhausted
+                            and now - last_progress_t.get(k, now)
+                            >= cfg.stall_timeout_s):
+                        self._detect_t[k] = time.time()
                         self._event("detect", worker=k, at_step=got["step"],
                                     kind="hung", stalled_at=step_k)
                         # a hung pid must die before its slot restarts
@@ -237,23 +344,49 @@ class ClusterSupervisor:
                 # that shows alive again counts as resumed
                 for k in list(watch_resume):
                     if alive.get(k):
-                        watch_resume.discard(k)
-                        self._event("resume", worker=k, step=got["step"])
+                        self.close_episode(k, got["step"])
 
             # ---- perform due restarts ---------------------------------
             for k in [k for k, due in pending_restart.items() if now >= due]:
                 del pending_restart[k]
                 self._restarts[k] = self._restarts.get(k, 0) + 1
-                try:
-                    self.backend.restart_worker(k)
-                except NotImplementedError:
-                    exhausted.add(k)
-                    self._event("restart_budget_exhausted", worker=k,
-                                restarts=self._restarts[k] - 1,
-                                reason="backend cannot restart workers")
-                    continue
+                # standby fast path first: promoting a parked,
+                # precompiled spare skips process boot AND compile; no
+                # ready spare (or no backend support) → cold respawn
+                promoted = False
+                if (cfg.standby_workers > 0
+                        and hasattr(self.backend, "promote_standby")):
+                    try:
+                        promoted = bool(self.backend.promote_standby(k))
+                    except Exception as e:
+                        # the fast path failing (torn activation file,
+                        # spawn pressure) must not cost the restart
+                        # itself — fall through to the cold respawn
+                        if not isinstance(e, NotImplementedError):
+                            logger.warning(
+                                "standby promotion for worker %d failed "
+                                "(%s: %s) — cold respawn", k,
+                                type(e).__name__, e)
+                        promoted = False
+                if not promoted:
+                    try:
+                        self.backend.restart_worker(k)
+                    except NotImplementedError:
+                        exhausted.add(k)
+                        self._event("restart_budget_exhausted", worker=k,
+                                    restarts=self._restarts[k] - 1,
+                                    reason="backend cannot restart workers")
+                        continue
+                self._respawn_t[k] = time.time()
+                extra = {}
+                if k in self._detect_t:
+                    extra["detected_at"] = self._detect_t[k]
+                    extra["respawn_s"] = round(
+                        self._respawn_t[k] - self._detect_t[k], 3)
                 self._event("restart", worker=k,
-                            attempt=self._restarts[k], at_step=got["step"])
+                            attempt=self._restarts[k], at_step=got["step"],
+                            via="standby" if promoted else "respawn",
+                            **extra)
                 watch_resume.add(k)
                 last_progress_t[k] = time.monotonic()
 

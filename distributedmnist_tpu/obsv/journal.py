@@ -28,6 +28,65 @@ def load_recovery_events(path: str | Path) -> list[dict]:
     return load_jsonl(path, event="recovery")
 
 
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def summarize_mttr(records: list[dict]) -> dict[str, Any]:
+    """MTTR (mean-time-to-recovery) over the recovery episodes in a
+    journal: each ``resume`` closes a detect→respawned→first-moved-step
+    episode. Prefers the explicit ``mttr_s`` the supervisor stamps on
+    resume events; legacy journals without it fall back to the wall
+    timestamps of the worker's pending detect. Always returns the dict
+    (``episodes: 0`` when none) so campaign reports can assert the
+    metric is PRESENT, not just non-crashing; ``unrecovered`` counts
+    detects no resume ever closed (exhausted budgets, teardown before
+    the restarted worker moved)."""
+    pending_detect: dict[int, float] = {}
+    episodes: list[float] = []
+    respawn: list[float] = []
+    by_worker: dict[int, list[float]] = {}
+    for rec in records:
+        action = rec.get("action")
+        k = rec.get("worker")
+        if action == "detect" and k is not None:
+            pending_detect[k] = rec.get("time")
+        elif action == "resume" and k is not None:
+            m = rec.get("mttr_s")
+            if m is None:
+                t0 = pending_detect.get(k)
+                t1 = rec.get("time")
+                m = (round(t1 - t0, 3)
+                     if t0 is not None and t1 is not None else None)
+            pending_detect.pop(k, None)
+            if m is not None:
+                episodes.append(m)
+                by_worker.setdefault(k, []).append(m)
+            if rec.get("resume_after_respawn_s") is not None:
+                respawn.append(rec["resume_after_respawn_s"])
+    # detects never closed by a resume: budget-exhausted workers (no
+    # recovery to time) or a run torn down before the restarted worker
+    # ever moved — surfaced instead of silently undercounting episodes
+    out: dict[str, Any] = {"episodes": len(episodes),
+                           "unrecovered": len(pending_detect)}
+    if episodes:
+        s = sorted(episodes)
+        out.update(mean_s=round(sum(s) / len(s), 3),
+                   p50_s=_percentile(s, 0.50),
+                   p90_s=_percentile(s, 0.90),
+                   max_s=s[-1],
+                   by_worker={k: v for k, v in sorted(by_worker.items())})
+    if respawn:
+        # the respawn→first-moved-step leg alone: what the compile
+        # cache / standby fast path actually shrinks
+        s = sorted(respawn)
+        out["resume_after_respawn_p50_s"] = _percentile(s, 0.50)
+        out["resume_after_respawn_max_s"] = s[-1]
+    return out
+
+
 def summarize_recovery_events(records: list[dict]) -> dict[str, Any]:
     """Aggregate recovery records into the episode's evidence:
 
@@ -38,7 +97,9 @@ def summarize_recovery_events(records: list[dict]) -> dict[str, Any]:
       kill → restart → resume episode,
     * ``quorum_transitions`` — the workers_alive trajectory,
     * ``resume_steps`` — {worker: step} where restarted workers picked
-      the run back up.
+      the run back up,
+    * ``mttr`` — detect→first-moved-step latency percentiles per
+      :func:`summarize_mttr` (present even when zero episodes).
     """
     by_action: dict[str, int] = {}
     by_worker: dict[int, list[str]] = {}
@@ -57,7 +118,8 @@ def summarize_recovery_events(records: list[dict]) -> dict[str, Any]:
             resume_steps[rec["worker"]] = rec.get("step")
     return {"events": len(records), "by_action": by_action,
             "by_worker": by_worker, "quorum_transitions": quorum,
-            "resume_steps": resume_steps}
+            "resume_steps": resume_steps,
+            "mttr": summarize_mttr(records)}
 
 
 def summarize_recovery(path: str | Path) -> dict[str, Any]:
@@ -78,6 +140,8 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
     by_invariant: dict[str, dict[str, int]] = {}
     failing: list[dict[str, Any]] = []
     reproducers: list[str] = []
+    mttr_trials: list[dict[str, Any]] = []
+    mttr_all: list[float] = []
     for rec in records:
         outcomes[rec.get("outcome", "?")] = (
             outcomes.get(rec.get("outcome", "?"), 0) + 1)
@@ -94,13 +158,39 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
         shrunk = rec.get("shrunk")
         if shrunk and shrunk.get("fault_plan_path"):
             reproducers.append(shrunk["fault_plan_path"])
+        m = rec.get("mttr")
+        if m is not None:
+            mttr_trials.append({"trial": rec.get("trial"),
+                                "episodes": m.get("episodes", 0),
+                                "unrecovered": m.get("unrecovered", 0),
+                                "p50_s": m.get("p50_s"),
+                                "max_s": m.get("max_s")})
+            mttr_all += [v for w in (m.get("by_worker") or {}).values()
+                         for v in w]
+    mttr: dict[str, Any] = {
+        "episodes": sum(t["episodes"] for t in mttr_trials),
+        # detects no resume ever closed (exhausted budgets, or a worker
+        # torn down before it moved): surfaced so "every recovery
+        # episode has an MTTR" is checkable, not assumed
+        "unrecovered": sum(t["unrecovered"] for t in mttr_trials),
+        "per_trial": mttr_trials}
+    if mttr_all:
+        s = sorted(mttr_all)
+        mttr.update(mean_s=round(sum(s) / len(s), 3),
+                    p50_s=_percentile(s, 0.50),
+                    p90_s=_percentile(s, 0.90), max_s=s[-1])
     return {"trials": len(records),
             "seed": records[0].get("seed") if records else None,
             "outcomes": outcomes,
             "invariants": by_invariant,
             "all_green": not failing and bool(records),
             "failing_trials": failing,
-            "reproducers": reproducers}
+            "reproducers": reproducers,
+            # MTTR as a first-class campaign metric: detect→first-
+            # moved-step latency over every recovery episode in every
+            # trial (the chaos CI asserts this key exists and uploads
+            # its one-line summary)
+            "mttr": mttr}
 
 
 def summarize_journal(path: str | Path) -> dict[str, Any]:

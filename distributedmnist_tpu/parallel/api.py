@@ -749,15 +749,68 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
     jitted = jax.jit(sharded, donate_argnums=0)
 
     zeros_ms: list[jax.Array] = []  # lazily built + cached default
+    # AOT fast path (parallel/aot.py): precompile() fills this with the
+    # ahead-of-time compiled executable + the argument signature it was
+    # lowered for; step_fn then dispatches matching concrete calls
+    # through it — the first training step after a precompile (or a
+    # warm-standby promotion) never waits on jit's compile path.
+    aot_box: dict[str, Any] = {}
+
+    def _default_measured() -> jax.Array:
+        if not zeros_ms:
+            zeros_ms.append(topo.zeros_measured())
+        return zeros_ms[0]
+
+    def _args_sig(args):
+        leaves, treedef = jax.tree.flatten(args)
+        return (treedef,
+                tuple((getattr(x, "shape", ()), getattr(x, "dtype", None))
+                      for x in leaves))
 
     def step_fn(state: TrainState, batch: dict,
                 measured_ms: jax.Array | None = None):
         if measured_ms is None:
-            if not zeros_ms:
-                zeros_ms.append(topo.zeros_measured())
-            measured_ms = zeros_ms[0]
+            measured_ms = _default_measured()
+        exe = aot_box.get("exe")
+        if exe is not None:
+            # one flatten covers both guards: tracers ANYWHERE in the
+            # args (a caller jitting over step_fn — e.g. bench's scanned
+            # chunks, or a jit closing over state but tracing the batch)
+            # must take the traceable jit path, and a different
+            # signature (a test swapping batch shapes) simply compiles
+            # through jit as before. Compared leafwise with early exit —
+            # no per-step sig allocation on this hot path.
+            leaves, treedef = jax.tree.flatten((state, batch, measured_ms))
+            sig_td, sig_leaves = aot_box["sig"]
+            if (treedef == sig_td and len(leaves) == len(sig_leaves)
+                    and not any(isinstance(x, jax.core.Tracer)
+                                for x in leaves)
+                    and all(getattr(x, "shape", ()) == s
+                            and getattr(x, "dtype", None) == d
+                            for x, (s, d) in zip(leaves, sig_leaves))):
+                return exe(state, batch, measured_ms)
         return jitted(state, batch, measured_ms)
 
+    def precompile(state: TrainState, batch: dict,
+                   measured_ms: jax.Array | None = None,
+                   cache_dir=None, cache_key: str | None = None
+                   ) -> dict[str, Any]:
+        """AOT-compile the step for these exact avals (no execution, no
+        donation — lowering only reads shapes) and arm the fast path.
+        With a cache_dir+key, the executable round-trips the disk cache
+        where the platform supports it (parallel/aot.py)."""
+        from . import aot as aot_lib
+        if measured_ms is None:
+            measured_ms = _default_measured()
+        compiled, info = aot_lib.aot_compile(
+            jitted, (state, batch, measured_ms),
+            cache_dir=cache_dir, key=cache_key)
+        aot_box["exe"] = compiled
+        aot_box["sig"] = _args_sig((state, batch, measured_ms))
+        return info
+
+    step_fn.precompile = precompile
+    step_fn.jitted = jitted
     return step_fn
 
 

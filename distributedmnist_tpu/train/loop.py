@@ -195,6 +195,11 @@ class Trainer:
         self._series: list[tuple[float, int, float, float]] = []  # (t, step, loss, acc)
         self._last_save_time = time.time()
         self._start_step = 0
+        # AOT precompile bookkeeping (cfg.compile): the compile record
+        # is journaled into train_log.jsonl separately from step time,
+        # and re-journaled after a standby adoption re-roots the log.
+        self._compile_info: dict[str, Any] | None = None
+        self._compile_logged = False
 
         if cfg.train.resume:
             self._maybe_resume()
@@ -414,6 +419,82 @@ class Trainer:
             if m.size:
                 np.save(self.train_dir / "step_times.npy", m)
 
+    def precompile(self) -> dict[str, Any]:
+        """AOT-compile the train step BEFORE the first batch (ROADMAP
+        item 5): compile time is measured here — and journaled as its
+        own ``event: "compile"`` record — instead of hiding inside the
+        first step's wall time, and a warm standby can park fully
+        compiled. Routed through the executable disk cache when a
+        persistent cache dir is configured (parallel/aot.py); idempotent
+        per Trainer."""
+        if self._compile_info is not None:
+            return self._compile_info
+        img = self.datasets.train.images
+        lbl = self.datasets.train.labels
+        B = self.cfg.data.batch_size
+        batch = {"image": np.zeros((B, *img.shape[1:]), img.dtype),
+                 "label": np.zeros((B, *lbl.shape[1:]), lbl.dtype)}
+        gbatch = self.topo.device_put_batch(batch,
+                                            seq_sharded=self.seq_sharded)
+        from ..core.compile_cache import cache_stats, resolve_cache_dir
+        # Deliberately NOT enable_persistent_cache here: flipping jax's
+        # global cache is an entry-point action (launch CLI,
+        # __graft_entry__ — one Trainer per process). Enabling it from
+        # inside the Trainer corrupts jaxlib 0.4.37 when a process
+        # builds several Trainers (measured: ~2/3 of two-Trainer runs
+        # segfault); library callers who want it call
+        # core.compile_cache.enable_persistent_cache once at startup.
+        cache_dir = (resolve_cache_dir(self.cfg.compile)
+                     if self.cfg.compile.aot_executable_cache else None)
+        cache_key = None
+        if cache_dir is not None:
+            from ..parallel.aot import aot_cache_key
+            cache_key = aot_cache_key(self.model, self.cfg, self.topo)
+        before = cache_stats(cache_dir) if cache_dir is not None else None
+        info = self.step_fn.precompile(self.state, gbatch,
+                                       cache_dir=cache_dir,
+                                       cache_key=cache_key)
+        if before is not None:
+            after = cache_stats(cache_dir)
+            # zero new entries across a compile = every program came
+            # out of the persistent cache — the warm-restart evidence
+            # the bench/CI artifacts surface
+            info["persistent_cache"] = {
+                "dir": str(cache_dir),
+                "entries": after["entries"],
+                "new_entries": after["entries"] - before["entries"],
+                "hits": after["hits"] - before["hits"],
+                "misses": after["misses"] - before["misses"]}
+        logger.info("precompiled train step in %.2fs (source=%s)",
+                    info["compile_s"], info["source"])
+        self._compile_info = info
+        return info
+
+    def adopt_train_dir(self, train_dir: str | Path) -> None:
+        """Re-root this trainer onto a different ``train_dir`` and
+        resume from whatever checkpoints live there — the warm-standby
+        promotion hook: a parked, precompiled process adopts a dead
+        worker's logdir and continues its run without paying boot or
+        compile again. Sinks and the TB writer are rebuilt against the
+        new dir; the compile record is re-journaled there so the
+        adopted log still carries the episode's compile evidence."""
+        for attr in ("_sink", "_recovery_sink"):
+            sink = getattr(self, attr)
+            if sink is not None:
+                sink.close()
+                setattr(self, attr, None)
+        self.train_dir = Path(train_dir)
+        self.train_dir.mkdir(parents=True, exist_ok=True)
+        if self._tb is not None:
+            from ..obsv.tb import SummaryWriter
+            self._tb.flush()
+            self._tb = SummaryWriter(self.train_dir / "tb")
+        self._compile_logged = False
+        self._series.clear()
+        self._start_step = 0
+        if self.cfg.train.resume:
+            self._maybe_resume()
+
     # ------------------------------------------------------------------
 
     def evaluate(self, split: str = "test") -> dict[str, float]:
@@ -561,6 +642,20 @@ class Trainer:
         prefetching = self._device_prefetch
 
         self.train_dir.mkdir(parents=True, exist_ok=True)
+        if self.cfg.compile.precompile and self._compile_info is None:
+            try:
+                self.precompile()
+            except Exception as e:
+                # the fast path must never cost a run: fall back to the
+                # classic first-step inline compile
+                logger.warning("precompile failed (%s: %s) — first step "
+                               "will compile inline", type(e).__name__, e)
+                self._compile_info = {"compile_s": None, "source": "inline",
+                                      "error": f"{type(e).__name__}: {e}"}
+        if self._compile_info is not None and not self._compile_logged:
+            self._sink_write({"event": "compile", "time": time.time(),
+                              **self._compile_info})
+            self._compile_logged = True
         step = self._start_step
         rollbacks = 0
         self._preempt_requested = None
@@ -713,5 +808,9 @@ class Trainer:
             # "preempted" to train.resumable_exit_code
             "preempted": self._preempt_requested,
             "nan_rollbacks": rollbacks,
+            # AOT/compile-cache evidence (None when precompile is off):
+            # where the executable came from and what the persistent
+            # cache did — journaled in train_log.jsonl too
+            "compile": self._compile_info,
         }
         return summary

@@ -9,6 +9,7 @@ is the ``slow``-marked e2e at the bottom.
 """
 
 import json
+from pathlib import Path
 
 import pytest
 
@@ -50,8 +51,8 @@ class _StubDrainCluster:
     """Duck-typed stand-in for LocalProcessCluster: one live worker
     with a fixed progress reading and a controllable spawned_at."""
 
-    def __init__(self, logdir, spawned_at):
-        self._worker = {"worker": 0, "pid": 1, "alive": True,
+    def __init__(self, logdir, spawned_at, alive=True):
+        self._worker = {"worker": 0, "pid": 1, "alive": alive,
                         "logdir": str(logdir), "spawned_at": spawned_at}
 
     def status(self):
@@ -123,6 +124,185 @@ def test_spawned_at_recorded_and_surfaced(tmp_path):
     finally:
         cluster.kill_all()
         cluster.exec.close()
+
+
+def test_promoted_standby_inherits_incarnation_spawned_at(tmp_path):
+    """Satellite (PR 4 drain edge, standby flavor): promotion must
+    stamp the worker's ``spawned_at`` with the PROMOTION time — the
+    drain's per-incarnation stall clock then stays parked until the
+    promoted process logs its first line in the adopted dir, exactly
+    as for a cold restart's boot. Without the fresh stamp, the
+    standby's ORIGINAL spawn time (long past) would unpark the clock
+    immediately and an old log line would read as 'logged, then
+    stalled'."""
+    import time
+
+    standby_cmd = ('touch "$DMT_STANDBY_ACTIVATION.ready"; '
+                   'while [ ! -f "$DMT_STANDBY_ACTIVATION" ]; '
+                   'do sleep 0.05; done; sleep 60')
+    cfg = LocalClusterConfig(name="pr", workdir=str(tmp_path / "cl"),
+                             num_workers=1, train_command="sleep 60",
+                             standby_command=standby_cmd)
+    ex = CommandExecutor(journal=cfg.root / "command_journal.jsonl",
+                         retry=RetryPolicy(max_attempts=1))
+    c = LocalProcessCluster(cfg, ex)
+    try:
+        c.create()
+        c.run_train()
+        first_spawn = c.status()["workers"][0]["spawned_at"]
+        c.ensure_standbys(1)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if any(sb["ready"] for sb in c.status().get("standbys", [])):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("standby never ready")
+        # an OLD log line predating the promotion: must read as
+        # "hasn't logged since promotion", i.e. clock parked
+        log = Path(c.cfg.worker_dir(0)) / "train_log.jsonl"
+        log.write_text('{"step": 3, "loss": 1.0}\n')
+        before = time.time()
+        assert c.promote_standby(0) is True
+        w = c.status()["workers"][0]
+        assert w["spawned_at"] >= before > first_spawn
+        assert ChaosCampaign._logged_since_spawn(w) is False
+        # the drain parks on exactly this reading (stub-clock cousin of
+        # test_drain_stall_clock_waits_for_post_restart_first_log)
+        camp = ChaosCampaign(ChaosConfig(name="prd",
+                                         workdir=str(tmp_path / "d"),
+                                         payload="shell", poll_secs=0.05,
+                                         drain_stall_s=0.25,
+                                         drain_timeout_s=1.2))
+        t0 = time.monotonic()
+        camp._drain(_StubDrainCluster(c.cfg.worker_dir(0),
+                                      spawned_at=w["spawned_at"]))
+        assert time.monotonic() - t0 >= 1.0, "drain gave up mid-adoption"
+    finally:
+        c.kill_all()
+        ex.close()
+
+
+def test_drain_closes_open_mttr_episode(tmp_path):
+    """Regression (the first seeded campaign's mttr.episodes=0): a
+    worker restarted near run-end finishes its boot DURING the drain —
+    the drain must close the supervised loop's open recovery episode
+    the tick that worker's log first moves since its own spawn, so the
+    trial's MTTR still counts the episode. A worker that resumed,
+    finished, and exited before the first drain tick (alive=False)
+    closes too; one that never logged since spawn stays open."""
+    import time
+
+    cfg = ChaosConfig(name="drain-m", workdir=str(tmp_path),
+                      payload="shell", poll_secs=0.05,
+                      drain_stall_s=0.25, drain_timeout_s=1.2)
+    camp = ChaosCampaign(cfg)
+    logdir = tmp_path / "worker0"
+    logdir.mkdir()
+    (logdir / "train_log.jsonl").write_text('{"step": 7, "loss": 1.0}\n')
+
+    def open_sup():
+        sup = ClusterSupervisor(_StubDrainCluster(logdir, None))
+        sup._watch_resume = {0}
+        sup._detect_t[0] = time.time() - 5.0
+        sup._respawn_t[0] = time.time() - 2.0
+        sup.events.append({"event": "recovery", "action": "detect",
+                           "worker": 0, "time": sup._detect_t[0]})
+        return sup
+
+    # (a) exited-after-finishing worker, log postdates its spawn: the
+    # pre-return sweep closes the episode with the drain's progress step
+    sup = open_sup()
+    camp._drain(_StubDrainCluster(logdir, spawned_at=time.time() - 3600,
+                                  alive=False), sup)
+    assert sup.open_episodes == set()
+    resume = next(e for e in sup.events if e["action"] == "resume")
+    assert resume["worker"] == 0 and resume["step"] == 7
+    assert resume["mttr_s"] == pytest.approx(5.0, abs=1.0)
+    assert resume["resume_after_respawn_s"] == pytest.approx(2.0, abs=1.0)
+    assert sup.summary()["mttr"]["episodes"] == 1
+
+    # (b) still booting (spawn postdates the log): never falsely closed
+    sup = open_sup()
+    camp._drain(_StubDrainCluster(logdir, spawned_at=time.time() + 3600,
+                                  alive=False), sup)
+    assert sup.open_episodes == {0}
+    assert sup.summary()["mttr"] == {"episodes": 0, "unrecovered": 1}
+
+    # (c) log moved since spawn but the newest record is the restarted
+    # trainer's compile event (it wedged before its first step): a
+    # compile write is NOT a resume — the episode must stay open
+    with open(logdir / "train_log.jsonl", "a") as fh:
+        fh.write('{"event": "compile", "compile_s": 1.2}\n')
+    sup = open_sup()
+    camp._drain(_StubDrainCluster(logdir, spawned_at=time.time() - 3600,
+                                  alive=False), sup)
+    assert sup.open_episodes == {0}
+    assert sup.summary()["mttr"]["unrecovered"] == 1
+
+
+# ---------------------------------------------------------------------------
+# adaptive stall timeout: derived from the measured boot, not hardcoded
+# ---------------------------------------------------------------------------
+
+def test_stall_timeout_derives_from_measured_boot():
+    cfg = ChaosConfig()
+    # un-measured: the historical worst-case default stands
+    assert cfg.resolved_stall_timeout_s() == 90.0
+    # measured warm boot: detection drops to mult×boot with a floor —
+    # the regression this satellite exists for: a stalled warm worker
+    # is detected in ~20 s, not 90
+    assert cfg.resolved_stall_timeout_s(measured_boot_s=4.0) == 20.0
+    assert cfg.resolved_stall_timeout_s(measured_boot_s=10.0) == 30.0
+    # a slow box never loosens past the old cap
+    assert cfg.resolved_stall_timeout_s(measured_boot_s=500.0) == 90.0
+    # explicit config and the shell payload are untouched
+    assert ChaosConfig(stall_timeout_s=7.0).resolved_stall_timeout_s(4.0) \
+        == 7.0
+    assert ChaosConfig(payload="shell").resolved_stall_timeout_s(4.0) == 2.5
+
+
+def test_campaign_threads_reference_boot_into_trial_stall_timeout(tmp_path):
+    """The campaign measures the reference run's spawn→first-log cost
+    and derives every trial's stall timeout from it (then keeps
+    re-deriving from each trial's own boots)."""
+    cfg = ChaosConfig(name="boot", trials=2, seed=0, until_step=20,
+                      workdir=str(tmp_path), payload="shell", shrink=False)
+    seen: list[tuple[str, float | None, float]] = []
+
+    class BootCampaign(ChaosCampaign):
+        def _run_trial(self, rel, plan, seed, num_workers,
+                       measured_boot_s=None):
+            stall = self.cfg.resolved_stall_timeout_s(measured_boot_s)
+            seen.append((rel, measured_boot_s, stall))
+            root = self.cfg.root / rel
+            root.mkdir(parents=True, exist_ok=True)
+            (root / "command_journal.jsonl").write_text("")
+            outcome = {"name": rel, "seed": seed, "target": 20,
+                       "num_workers": num_workers, "outcome": "completed",
+                       "step": 20, "boot_s": 6.0 if rel == "reference"
+                       else 2.0,
+                       "supervisor": {"quorum": 1,
+                                      "max_restarts_per_worker": 2,
+                                      "stall_timeout_s": stall},
+                       "recovery": {"mttr": {"episodes": 0}},
+                       "fault_plan": plan.to_json_dict(),
+                       "duration_s": 0.0, "reference_dir": None}
+            (root / "outcome.json").write_text(json.dumps(outcome))
+            return outcome
+
+    summary = BootCampaign(cfg).run()
+    assert [s[0] for s in seen] == ["reference", "trial000", "trial001"]
+    assert seen[0][1] is None                       # reference: unmeasured
+    assert seen[1][1] == 6.0                        # ref's measured boot
+    assert seen[2][1] == 2.0                        # trial000's warm boot
+    # shell payload keeps its own default; the derivation is visible in
+    # the per-trial report records regardless of payload
+    report = (cfg.root / "chaos_report.jsonl").read_text().splitlines()
+    recs = [json.loads(l) for l in report]
+    assert [r["boot_s"] for r in recs] == [2.0, 2.0]
+    assert all("mttr" in r for r in recs)
+    assert "mttr" in summary and summary["mttr"]["episodes"] == 0
 
 
 # ---------------------------------------------------------------------------
@@ -390,7 +570,8 @@ def test_campaign_shrinks_seeded_synthetic_failure(tmp_path):
                       shrink=True, shrink_max_probes=8)
 
     class SyntheticCampaign(ChaosCampaign):
-        def _run_trial(self, rel, plan, seed, num_workers):
+        def _run_trial(self, rel, plan, seed, num_workers,
+                       measured_boot_s=None):
             root = self.cfg.root / rel
             root.mkdir(parents=True, exist_ok=True)
             (root / "command_journal.jsonl").write_text("")

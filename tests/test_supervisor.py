@@ -267,6 +267,198 @@ def test_supervise_cli_dry_run(tmp_path, capsys):
 
 
 # ---------------------------------------------------------------------------
+# warm standbys + MTTR (ROADMAP item 5)
+# ---------------------------------------------------------------------------
+
+# A standby realization of the resuming shell loop: signal ready, park
+# until the promotion writes the activation file, then adopt the
+# assigned worker dir and run the same loop there (what `launch train`
+# does natively via DMT_STANDBY_ACTIVATION + Trainer.adopt_train_dir).
+_STANDBY_LOOP = (
+    'touch "$DMT_STANDBY_ACTIVATION.ready"; '
+    'while [ ! -f "$DMT_STANDBY_ACTIVATION" ]; do sleep 0.05; done; '
+    'cd "$(python3 -c "import json,os;'
+    "print(json.load(open(os.environ['DMT_STANDBY_ACTIVATION']))"
+    "['train_dir'])" '")" && ' + _RESUMING_LOOP)
+
+
+def _standby_cluster(tmp_path, fault_plan=None,
+                     standby_command=_STANDBY_LOOP):
+    cfg = LocalClusterConfig(name="sup", workdir=str(tmp_path / "cl"),
+                             num_workers=2, train_command=_RESUMING_LOOP,
+                             standby_command=standby_command)
+    ex = CommandExecutor(journal=cfg.root / "command_journal.jsonl",
+                         retry=RetryPolicy(max_attempts=1),
+                         fault_plan=fault_plan)
+    return LocalProcessCluster(cfg, ex)
+
+
+def test_standby_promotion_resumes_worker_with_mttr(tmp_path):
+    """A killed worker is recovered by PROMOTING the parked standby
+    (journaled as restart via=standby), which resumes from the dead
+    worker's checkpoint; the resume event closes the episode with
+    detect→respawned→first-moved-step latencies and the summary
+    reports MTTR percentiles. The pool back-fills after promotion."""
+    c = _standby_cluster(tmp_path,
+                         fault_plan=FaultPlan(kill_worker_at_step={1: 7}))
+    c.create()
+    sup = ClusterSupervisor(c, SupervisorConfig(
+        quorum=1, max_restarts_per_worker=2, restart_backoff_s=0.1,
+        standby_workers=1))
+    got = sup.run_until_step(60, poll_secs=0.2, timeout_secs=120.0)
+    assert got["step"] >= 60
+    restart = next(e for e in sup.events if e["action"] == "restart")
+    assert restart["via"] == "standby"
+    assert restart["respawn_s"] >= 0
+    resume = next(e for e in sup.events if e["action"] == "resume")
+    assert resume["mttr_s"] > 0
+    assert resume["detected_at"] <= resume["respawned_at"]
+    mttr = got["recovery"]["mttr"]
+    assert mttr["episodes"] == 1 and mttr["p50_s"] == mttr["max_s"] > 0
+    # promoted process adopted worker 1's dir and RESUMED from its ckpt
+    boots = [int(l) for l in (c.cfg.worker_dir(1) / "boots.txt")
+             .read_text().split()]
+    assert len(boots) == 2 and boots[1] > 0 and boots[1] % 5 == 0, boots
+    # per-incarnation clock: promotion stamped a fresh spawned_at on
+    # the worker (the chaos drain's stall parking keys off it)
+    w1 = next(w for w in c.status()["workers"] if w["worker"] == 1)
+    assert w1["spawned_at"] >= resume["respawned_at"] - 1.0
+    # the pool back-filled with a FRESH slot id (never the consumed
+    # standby's dir, where a stale activation file would instantly
+    # mis-activate the new spare)
+    state = json.loads(c.state_path.read_text())
+    assert [sb["standby"] for sb in state["standbys"]] == [1]
+    c.delete()
+
+
+def test_no_ready_standby_falls_back_to_cold_restart(tmp_path):
+    """Standbys that never reach ready (still booting, wedged) must not
+    stall recovery: the due restart falls back to a cold respawn."""
+    c = _standby_cluster(tmp_path,
+                         fault_plan=FaultPlan(kill_worker_at_step={1: 7}),
+                         standby_command="sleep 600")  # never ready
+    c.create()
+    sup = ClusterSupervisor(c, SupervisorConfig(
+        quorum=1, max_restarts_per_worker=2, restart_backoff_s=0.1,
+        standby_workers=1))
+    got = sup.run_until_step(45, poll_secs=0.2, timeout_secs=120.0)
+    assert got["step"] >= 45
+    restart = next(e for e in sup.events if e["action"] == "restart")
+    assert restart["via"] == "respawn"
+    assert got["recovery"]["mttr"]["episodes"] == 1
+    c.delete()
+
+
+class _ScriptedBackend:
+    """Scripted poll sequence — deterministic tick-level control the
+    real process cluster can't give: worker 1 dies, is restarted, and
+    its log first moves on the SAME tick worker 0 reaches the target."""
+
+    def __init__(self, script):
+        self.script = script  # [(step, {worker: alive}, {worker: step})]
+        self.tick = 0
+        self.restarted = []
+
+    def _frame(self):
+        return self.script[min(self.tick, len(self.script) - 1)]
+
+    def poll(self):
+        step, alive, prog = self._frame()
+        self.tick += 1
+        return {"step": step,
+                "workers": [{"worker": k, "alive": a}
+                            for k, a in alive.items()],
+                "worker_progress": dict(prog)}
+
+    def worker_progress(self):
+        return dict(self._frame()[2])
+
+    def restart_worker(self, k):
+        self.restarted.append(k)
+
+    def kill_all(self, worker="all"):
+        pass
+
+
+def test_resume_on_target_tick_still_closes_mttr_episode():
+    """Regression: the run completing must not swallow the recovery
+    episode. Worker 1's post-restart log movement lands on the very
+    tick worker 0 reaches the target — the resume (and its MTTR
+    fields) must be journaled BEFORE target_reached returns, or the
+    trial reports mttr.episodes=0 despite a full detect→restart chain
+    (the exact undercount the first seeded chaos campaign showed)."""
+    backend = _ScriptedBackend([
+        # tick 1: worker 1 dead → detect + immediate (0-backoff)
+        # restart, watch_resume={1}
+        (5, {0: True, 1: False}, {0: 5, 1: 4}),
+        # tick 2: worker 1's log moves AND worker 0 hits the target
+        (10, {0: True, 1: True}, {0: 10, 1: 6}),
+    ])
+    sup = ClusterSupervisor(backend, SupervisorConfig(
+        quorum=1, max_restarts_per_worker=2, restart_backoff_s=0.0))
+    got = sup.supervise_until_step(10, poll_secs=0.05, timeout_secs=10.0)
+    assert got["step"] >= 10 and backend.restarted == [1]
+    resume = next(e for e in sup.events if e["action"] == "resume")
+    assert resume["worker"] == 1 and resume["step"] == 6
+    assert resume["mttr_s"] > 0 and resume["detected_at"] > 0
+    mttr = got["recovery"]["mttr"]
+    assert mttr["episodes"] == 1 and mttr["unrecovered"] == 0
+    assert sup.open_episodes == set()
+    # the events are ordered evidence: resume precedes target_reached
+    actions = [e["action"] for e in sup.events]
+    assert actions.index("resume") < actions.index("target_reached")
+
+
+def test_open_episode_surfaces_as_unrecovered_and_close_episode():
+    """A run that ends while the restarted worker is still booting
+    leaves the episode OPEN: the summary counts it as unrecovered
+    (never silently dropped), open_episodes names the worker, and a
+    later close_episode — the chaos drain observing the worker's first
+    post-boot log line — journals the closing resume with MTTR."""
+    backend = _ScriptedBackend([
+        (5, {0: True, 1: False}, {0: 5, 1: 4}),
+        # worker 1 restarted but its log NEVER moves before the target
+        (10, {0: True, 1: True}, {0: 10, 1: 4}),
+    ])
+    sup = ClusterSupervisor(backend, SupervisorConfig(
+        quorum=1, max_restarts_per_worker=2, restart_backoff_s=0.0))
+    got = sup.supervise_until_step(10, poll_secs=0.05, timeout_secs=10.0)
+    assert sup.open_episodes == {1}
+    mttr = got["recovery"]["mttr"]
+    assert mttr["episodes"] == 0 and mttr["unrecovered"] == 1
+    sup.close_episode(1, step=7)
+    assert sup.open_episodes == set()
+    resume = next(e for e in sup.events if e["action"] == "resume")
+    assert resume["step"] == 7 and resume["mttr_s"] > 0
+    mttr = sup.summary()["mttr"]
+    assert mttr["episodes"] == 1 and mttr["unrecovered"] == 0
+    sup.close_episode(1, step=8)  # idempotent: no second resume
+    assert sum(1 for e in sup.events if e["action"] == "resume") == 1
+
+
+def test_summarize_mttr_percentiles_and_legacy_fallback():
+    from distributedmnist_tpu.obsv.journal import summarize_mttr
+    # explicit mttr_s (the supervisor's stamped episodes)
+    events = []
+    for k, m in ((0, 2.0), (1, 4.0), (1, 10.0)):
+        events.append({"action": "detect", "worker": k, "time": 100.0})
+        events.append({"action": "resume", "worker": k, "time": 100.0 + m,
+                       "mttr_s": m, "resume_after_respawn_s": m / 2})
+    got = summarize_mttr(events)
+    assert got["episodes"] == 3
+    assert got["p50_s"] == 4.0 and got["max_s"] == 10.0
+    assert got["mean_s"] == pytest.approx(16.0 / 3, abs=1e-3)
+    assert got["by_worker"] == {0: [2.0], 1: [4.0, 10.0]}
+    assert got["resume_after_respawn_max_s"] == 5.0
+    # legacy journal without mttr_s: falls back to event timestamps
+    legacy = [{"action": "detect", "worker": 0, "time": 50.0},
+              {"action": "resume", "worker": 0, "time": 53.5}]
+    assert summarize_mttr(legacy)["max_s"] == 3.5
+    # no episodes: the key is still present and countable
+    assert summarize_mttr([])["episodes"] == 0
+
+
+# ---------------------------------------------------------------------------
 # acceptance e2e: REAL `launch train` workers, mid-run kill + corrupted
 # latest checkpoint — the supervised run still reaches the target, the
 # restarted worker falls back to the previous loadable step, and the

@@ -61,21 +61,29 @@ def test_fresh_run_truncates_train_log(tmp_train_dir, synthetic_datasets):
     from pathlib import Path
 
     log = Path(tmp_train_dir) / "train_log.jsonl"
+
+    def step_series():
+        # the log is event-typed (step records ride beside the
+        # compile record the AOT precompile journals)
+        return [r["step"] for r in map(json.loads,
+                                       log.read_text().splitlines())
+                if r.get("event", "step") == "step"]
+
     make_trainer(tmp_train_dir, synthetic_datasets,
                  train={"max_steps": 4, "log_every_steps": 2}).run()
-    n_first = len(log.read_text().splitlines())
+    n_first = len(step_series())
 
     # fresh rerun (resume off): old series replaced, steps restart at 1
     make_trainer(tmp_train_dir, synthetic_datasets,
                  train={"max_steps": 4, "log_every_steps": 2,
                         "resume": False}).run()
-    steps = [json.loads(l)["step"] for l in log.read_text().splitlines()]
+    steps = step_series()
     assert len(steps) == n_first and steps[0] == 1
 
     # resumed run: appends, series stays monotone
     make_trainer(tmp_train_dir, synthetic_datasets,
                  train={"max_steps": 6, "log_every_steps": 2}).run()
-    steps = [json.loads(l)["step"] for l in log.read_text().splitlines()]
+    steps = step_series()
     assert steps == sorted(steps) and steps[-1] == 6
 
 
