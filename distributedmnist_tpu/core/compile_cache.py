@@ -54,6 +54,36 @@ def resolve_cache_dir(cfg: CompileConfig | None = None) -> Path | None:
     return Path(raw) if raw else None
 
 
+#: jax releases whose serialized executables are UNSAFE to load in a
+#: different process than the one that compiled them. Measured on this
+#: container's 0.4.37: a restarted worker reading its predecessor's
+#: persistent-cache (or AOT) entries computes wrong numerics at its
+#: first resumed step and segfaults within a few more — dense and
+#: ZeRO-1 programs alike, graceful-drain and SIGKILL handoffs alike
+#: (13/13 corrupt with the cache on, 0/4 without). This is the
+#: cross-process face of the same-process reload corruption the AOT
+#: cache already refuses via its pid stamp. Newer jax releases fall
+#: outside the tuple and re-enable automatically.
+_CROSS_PROCESS_UNSAFE_MAX = (0, 4, 37)
+
+
+def cross_process_reuse_quarantined() -> str | None:
+    """Reason string when loading compile-cache entries written by a
+    DIFFERENT process is known to corrupt this jax, else None. Version
+    check only — no backend touch, so entry points may call this
+    before the mesh is forced."""
+    import jax
+    try:
+        ver = tuple(int(x) for x in jax.__version__.split(".")[:3])
+    except ValueError:
+        return None  # dev/dirty version string: assume current = fixed
+    if ver <= _CROSS_PROCESS_UNSAFE_MAX:
+        return (f"jax {jax.__version__} deserializes corrupt "
+                "executables cross-process (wrong numerics then "
+                "SIGSEGV on restarted workers — measured)")
+    return None
+
+
 def _install_listener() -> None:
     global _listener_installed
     if _listener_installed:
@@ -86,6 +116,18 @@ def enable_persistent_cache(cfg: CompileConfig | None = None) -> Path | None:
     cfg = cfg or CompileConfig()
     cache_dir = resolve_cache_dir(cfg)
     if cache_dir is None:
+        return None
+    reason = cross_process_reuse_quarantined()
+    if reason is not None and not cfg.trust_cache_cross_process:
+        # The persistent cache's ONLY value is cross-process reuse
+        # (in-process recompiles hit jax's in-memory caches first), so
+        # a quarantined jax disables it outright: a restart must train
+        # with a cold compile rather than resume on corrupt numerics.
+        # compile.trust_cache_cross_process=true overrides for
+        # platforms someone has actually validated.
+        logger.warning("persistent compile cache QUARANTINED: %s — "
+                       "compiles stay cold (override: "
+                       "compile.trust_cache_cross_process)", reason)
         return None
     try:
         cache_dir.mkdir(parents=True, exist_ok=True)

@@ -267,6 +267,17 @@ class CompileConfig:
     min_compile_time_secs: float = 0.0
     precompile: bool = True
     aot_executable_cache: bool = True
+    # Cross-process cache reuse is QUARANTINED on jaxlib <= 0.4.37: a
+    # restarted worker that loads executables serialized by its dead
+    # predecessor computes wrong numerics and then segfaults (measured
+    # on this container — dense and ZeRO-1 alike, graceful or SIGKILL
+    # handoff; the cross-process face of the same-process reload
+    # corruption the AOT cache already refuses via its pid stamp).
+    # enable_persistent_cache and the AOT disk cache both refuse on a
+    # quarantined jax unless this override asserts the platform has
+    # been validated (e.g. a real TPU backend where serialization is
+    # known good).
+    trust_cache_cross_process: bool = False
 
 
 @dataclass(frozen=True)

@@ -32,6 +32,12 @@ class BatchIterator:
             raise ValueError(f"global batch {batch_size} not divisible by "
                              f"{num_hosts} hosts")
         self.local_batch = batch_size // num_hosts
+        # the cursor's WORLD: hosts consume in lockstep (one local batch
+        # per host per global batch), so a cursor can be re-expressed
+        # under a different host count — see restore()
+        self.host_id = host_id
+        self.num_hosts = num_hosts
+        self.global_batch = batch_size
         if shard_mode == "sharded":
             self.data = data.shard(host_id, num_hosts) if num_hosts > 1 else data
             self.seed = seed  # same shuffle stream, disjoint data
@@ -71,12 +77,48 @@ class BatchIterator:
         self._pos += self.local_batch
         return {"image": self.data.images[idx], "label": self.data.labels[idx]}
 
+    @property
+    def batches_per_epoch(self) -> int:
+        """Full local batches one epoch of THIS host's shard yields
+        (the ragged tail is dropped, matching ``__next__``)."""
+        return self.data.num_examples // self.local_batch
+
+    @property
+    def batches_consumed(self) -> int:
+        """Lockstep global-batch count this cursor has advanced through
+        — the world-size-independent coordinate every host of every
+        world agrees on (each global batch consumes exactly one local
+        batch on every host)."""
+        return (self._epoch * self.batches_per_epoch
+                + self._pos // self.local_batch)
+
     def state(self) -> dict:
         """Checkpointable position (the reference cannot resume its
-        data stream; we can). Tagged with the shuffle implementation:
+        data stream; we can). Tagged with the shuffle implementation —
         an (epoch, pos) cursor only identifies a stream position within
-        ONE permutation sequence."""
-        return {"impl": "numpy", "epoch": self._epoch, "pos": self._pos}
+        ONE permutation sequence — and with the WORLD it was taken
+        under plus the world-independent ``batches`` coordinate, so a
+        resume onto a different host count can re-derive its own
+        (epoch, pos) instead of misreading a foreign shard's cursor."""
+        return {"impl": "numpy", "epoch": self._epoch, "pos": self._pos,
+                "batches": self.batches_consumed,
+                "world": {"num_hosts": self.num_hosts,
+                          "host_id": self.host_id,
+                          "batch_size": self.global_batch}}
+
+    def seek_batches(self, batches: int) -> None:
+        """Position the stream exactly ``batches`` global batches in —
+        the old-world→new-world cursor reassignment: ``batches`` is
+        host-count-independent, so every host of the NEW world seeks to
+        the same lockstep coordinate and the union of consumed sample
+        slots continues gap- and overlap-free across the world change
+        (see :func:`consumed_sample_ranges`)."""
+        if batches < 0:
+            raise ValueError(f"batches must be >= 0, got {batches}")
+        bpe = self.batches_per_epoch
+        self._epoch = batches // bpe
+        self._order = self._epoch_order(self._epoch)
+        self._pos = (batches % bpe) * self.local_batch
 
     def restore(self, state: dict) -> None:
         impl = state.get("impl", "numpy")
@@ -85,9 +127,50 @@ class BatchIterator:
                 f"data-iterator state was produced by the {impl!r} pipeline; "
                 "restoring it into the numpy shuffle stream would replay a "
                 "different permutation")
+        world = state.get("world")
+        if world is not None and (
+                world.get("num_hosts") != self.num_hosts
+                or world.get("host_id") != self.host_id
+                or world.get("batch_size") != self.global_batch):
+            # cross-world resume (elastic reconfigure, or a grown
+            # worker seeded with a survivor's checkpoint): the saved
+            # (epoch, pos) indexes a DIFFERENT shard's permutation —
+            # reassign via the lockstep batch coordinate so no sample
+            # range is dropped or double-visited
+            batches = state.get("batches")
+            if batches is None:
+                raise ValueError(
+                    f"data-iterator state from world {world} has no "
+                    f"'batches' coordinate; cannot reassign it to world "
+                    f"(num_hosts={self.num_hosts}, host_id={self.host_id}, "
+                    f"batch_size={self.global_batch})")
+            self.seek_batches(int(batches))
+            return
         self._epoch = int(state["epoch"])
         self._order = self._epoch_order(self._epoch)
         self._pos = int(state["pos"])
+
+
+def consumed_sample_ranges(state: dict) -> list[tuple[int, int]]:
+    """The half-open global CONSUMPTION-SLOT index ranges a cursor
+    state covers: global batch ``b`` assigns slots
+    ``[b·B + h·lb, b·B + (h+1)·lb)`` to host ``h`` (``B`` = global
+    batch, ``lb = B / num_hosts``). Under lockstep consumption the
+    union over a world's hosts is exactly ``[0, batches·B)`` and the
+    per-host ranges are disjoint — which is the old-world→new-world
+    reassignment contract: after :meth:`BatchIterator.restore` onto a
+    different host count, the new world's union equals the old world's
+    (no slot dropped, none double-visited). The property test in
+    tests/test_elastic.py pins this."""
+    world = state.get("world")
+    if world is None or state.get("batches") is None:
+        raise ValueError("cursor state carries no world/batches "
+                         "coordinates (legacy pre-elastic state)")
+    B = int(world["batch_size"])
+    h = int(world["host_id"])
+    lb = B // int(world["num_hosts"])
+    batches = int(state["batches"])
+    return [(b * B + h * lb, b * B + (h + 1) * lb) for b in range(batches)]
 
 
 def eval_batches(data: ArrayDataset, batch_size: int, pad_multiple: int = 1,

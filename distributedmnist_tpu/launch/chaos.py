@@ -45,13 +45,15 @@ from typing import Any
 
 from ..core.log import get_logger
 from ..obsv.invariants import check_run, shrink_faults
-from .cluster import (ClusterError, LocalClusterConfig, LocalProcessCluster)
+from .cluster import (ClusterError, LocalClusterConfig, LocalProcessCluster,
+                      worker_logged_since_spawn,
+                      worker_resumed_step_since_spawn)
 from .exec import CommandExecutor, FaultPlan, RetryPolicy
 from .supervisor import ClusterSupervisor, SupervisorConfig
 
 logger = get_logger("chaos")
 
-FAULT_KINDS = ("kill", "hang", "stall", "corrupt", "delay")
+FAULT_KINDS = ("kill", "hang", "stall", "corrupt", "delay", "resize")
 
 # The cheap non-jax payload (the supervisor tests' resuming shell loop):
 # ~20 steps/s, a file "checkpoint" every 5 steps so restarts observably
@@ -89,18 +91,23 @@ _TRAIN_PAYLOAD = (
 class ChaosFault:
     """One scheduled fault. ``ms`` is the stall duration (kind=stall)
     or injected delay (kind=delay); ``verb`` names the delayed command
-    class (kind=delay only, worker ignored)."""
+    class (kind=delay only, worker ignored); ``world`` the target
+    world size (kind=resize only — cluster-level, worker ignored: the
+    supervisor shrinks/grows the whole roster at the trigger step)."""
 
     kind: str
     worker: int = 0
     step: int = 0
     ms: float = 0.0
     verb: str = ""
+    world: int = 0
 
     def to_dict(self) -> dict[str, Any]:
         d: dict[str, Any] = {"kind": self.kind}
         if self.kind == "delay":
             d.update(verb=self.verb, ms=self.ms)
+        elif self.kind == "resize":
+            d.update(step=self.step, world=self.world)
         else:
             d.update(worker=self.worker, step=self.step)
             if self.kind == "stall":
@@ -122,6 +129,7 @@ class ChaosSchedule:
         stall: dict[int, tuple[int, float]] = {}
         corrupt: dict[int, int] = {}
         delay: dict[str, float] = {}
+        resize: tuple[int, int] | None = None
         for f in self.faults:
             if f.kind == "kill":
                 kill[f.worker] = f.step
@@ -133,13 +141,16 @@ class ChaosSchedule:
                 corrupt[f.worker] = f.step
             elif f.kind == "delay":
                 delay[f.verb] = f.ms
+            elif f.kind == "resize":
+                resize = (f.step, f.world)
             else:
                 raise ClusterError(f"unknown chaos fault kind {f.kind!r}")
         return FaultPlan(kill_worker_at_step=kill,
                          hang_worker_at_step=hang,
                          stall_worker_for_ms_at_step=stall,
                          corrupt_latest_checkpoint_at_step=corrupt,
-                         delay_ms=delay)
+                         delay_ms=delay,
+                         resize_world_at_step=resize)
 
     def to_json_dict(self) -> dict[str, Any]:
         return {"seed": self.seed, "trial": self.trial,
@@ -150,6 +161,7 @@ class ChaosSchedule:
             return "fault-free"
         return " + ".join(
             (f"{f.kind}(verb={f.verb}, {f.ms:.0f}ms)" if f.kind == "delay"
+             else f"{f.kind}(→{f.world}w@{f.step})" if f.kind == "resize"
              else f"{f.kind}(w{f.worker}@{f.step}"
                   + (f", {f.ms:.0f}ms)" if f.kind == "stall" else ")"))
             for f in self.faults)
@@ -159,7 +171,9 @@ def generate_schedule(seed: int, trial: int, num_workers: int,
                       step_window: tuple[int, int],
                       max_faults: int = 3, min_faults: int = 1,
                       stall_ms_range: tuple[float, float] = (500.0, 3000.0),
-                      delay_prob: float = 0.15) -> ChaosSchedule:
+                      delay_prob: float = 0.15,
+                      resize_worlds: tuple[int, ...] = (),
+                      resize_prob: float = 0.5) -> ChaosSchedule:
     """Sample one bounded-intensity schedule. Deterministic in
     (seed, trial). At most one fault of each kind per worker (the
     FaultPlan dicts are worker-keyed). A ``corrupt`` draw always rides
@@ -168,7 +182,13 @@ def generate_schedule(seed: int, trial: int, num_workers: int,
     overwritten — so if that worker's kill was already armed elsewhere
     the corruption moves to the kill's step. ``max_faults`` bounds
     intensity UNITS (a corrupt+kill pair is one unit; the fault list
-    may hold up to ``max_faults + 1`` entries)."""
+    may hold up to ``max_faults + 1`` entries).
+
+    ``resize_worlds``: candidate world sizes for the sixth fault kind
+    — at most one cluster-level ``resize`` per schedule, drawn with
+    ``resize_prob`` when the candidate set is non-empty. Drawn AFTER
+    every legacy draw, so any (seed, trial) schedule from a
+    resize-less config is byte-identical to what it always was."""
     import random
     rng = random.Random(seed * 1_000_003 + trial)
     lo, hi = step_window
@@ -219,7 +239,52 @@ def generate_schedule(seed: int, trial: int, num_workers: int,
         faults.append(ChaosFault(
             kind="delay", verb=rng.choice(("poll", "status", "progress")),
             ms=rng.uniform(5.0, 50.0)))
+    if resize_worlds and rng.random() < resize_prob:
+        faults.append(ChaosFault(
+            kind="resize", step=rng.randint(lo, hi),
+            world=int(rng.choice(tuple(resize_worlds)))))
     return ChaosSchedule(seed=seed, trial=trial, faults=tuple(faults))
+
+
+def count_fired_faults(trial_dir: Path,
+                       schedule: ChaosSchedule) -> dict[str, Any]:
+    """Scheduled-vs-actually-fired accounting for one trial, from the
+    command journal alone. PR 7 left "the kill lands after run-end →
+    zero episodes, still green" indistinguishable from a real
+    all-quiet run; this makes the distinction a report fact the
+    nightly gate can assert on (``fired > 0``). Every injector
+    journals its firing: worker faults as ``event: "fault"`` records,
+    exec delays as ``injected_delay_ms`` on command records, the
+    resize fault as the supervisor's ``event: "reconfigure"`` begin
+    with ``trigger: "fault_plan"``."""
+    from ..obsv.report import load_jsonl
+    records = load_jsonl(trial_dir / "command_journal.jsonl")
+    fault_actions = {"kill": "kill_worker", "hang": "hang_worker",
+                     "stall": "stall_worker",
+                     "corrupt": "corrupt_latest_checkpoint"}
+    fired_kw = {(r.get("action"), r.get("worker"))
+                for r in records if r.get("event") == "fault"}
+    delay_fired = any(r.get("event") == "command"
+                      and r.get("injected_delay_ms")
+                      for r in records)
+    resize_fired = any(r.get("event") == "reconfigure"
+                       and r.get("action") == "begin"
+                       and r.get("trigger") == "fault_plan"
+                       for r in records)
+    out: dict[str, Any] = {"scheduled": len(schedule.faults), "fired": 0,
+                           "unfired": []}
+    for f in schedule.faults:
+        if f.kind == "delay":
+            fired = delay_fired
+        elif f.kind == "resize":
+            fired = resize_fired
+        else:
+            fired = (fault_actions[f.kind], f.worker) in fired_kw
+        if fired:
+            out["fired"] += 1
+        else:
+            out["unfired"].append(f.to_dict())
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -248,6 +313,13 @@ class ChaosConfig:
     min_faults: int = 1
     last_fault_frac: float = 0.5   # faults land in the run's first half
     stall_ms_range: tuple[float, float] | None = None  # None = per-payload
+    # The sixth fault kind: elastic shrink/grow mid-run. 0 disables
+    # (default — resize-less configs reproduce their historical
+    # schedules exactly); the nightly chaos CI turns it on. Candidate
+    # worlds None = auto: shrink to num_workers-1, plus grow to
+    # num_workers+1 when warm standbys exist to absorb it.
+    resize_prob: float = 0.0
+    resize_worlds: tuple[int, ...] | None = None
     # supervisor policy under test
     quorum: int = 1
     max_restarts: int = 2
@@ -288,6 +360,8 @@ class ChaosConfig:
             raise ClusterError(f"unknown chaos config keys: {sorted(unknown)}")
         if "stall_ms_range" in d and d["stall_ms_range"] is not None:
             d["stall_ms_range"] = tuple(d["stall_ms_range"])
+        if "resize_worlds" in d and d["resize_worlds"] is not None:
+            d["resize_worlds"] = tuple(int(w) for w in d["resize_worlds"])
         return cls(**d)
 
     # -- per-payload defaults -------------------------------------------
@@ -324,6 +398,18 @@ class ChaosConfig:
         # the supervisor should WAIT out, never restart)
         return (500.0, 4000.0) if self.payload == "shell" else (
             2000.0, 8000.0)
+
+    def resolved_resize_worlds(self) -> tuple[int, ...]:
+        if self.resize_prob <= 0:
+            return ()
+        if self.resize_worlds is not None:
+            return tuple(self.resize_worlds)
+        worlds: list[int] = []
+        if self.num_workers > 1:
+            worlds.append(self.num_workers - 1)  # shrink
+        if self.standby_workers > 0:
+            worlds.append(self.num_workers + 1)  # warm grow
+        return tuple(worlds)
 
     def resolved_train_command(self) -> str:
         if self.train_command:
@@ -417,6 +503,12 @@ class ChaosCampaign:
             # spawn→first-log cost of THIS run's workers: the adaptive
             # stall timeout for later trials derives from it
             outcome["boot_s"] = cluster.measured_boot_s()
+            # the world the trial ENDED at (a resize fault or elastic
+            # shrink reshaped the roster mid-run; the reconfigure
+            # invariant cross-checks this against the journal)
+            st = cluster.status()
+            if st is not None:
+                outcome["final_world"] = len(st["workers"])
         except ClusterError as e:
             aborted = any(ev.get("action") == "below_quorum_abort"
                           for ev in sup.events)
@@ -431,70 +523,19 @@ class ChaosCampaign:
             json.dumps(outcome, indent=2, default=str))
         return outcome
 
+    # spawn-observation helpers: the logic moved to launch/cluster.py
+    # (worker_logged_since_spawn / worker_resumed_step_since_spawn) so
+    # the supervisor's reconfigure-resume watch shares it; these thin
+    # delegates keep the established chaos-side names.
+
     @staticmethod
     def _logged_since_spawn(worker: dict) -> bool:
-        """Has this worker appended to its own train_log.jsonl since
-        its CURRENT incarnation spawned? False means it is still
-        booting (a restarted jax worker spends ~15-30 s before its
-        first log line). Unknown spawn time (pre-``spawned_at`` state
-        files) reads as True — the legacy behavior."""
-        spawned = worker.get("spawned_at")
-        if spawned is None:
-            return True
-        log = Path(worker["logdir"]) / "train_log.jsonl"
-        try:
-            return log.stat().st_mtime >= spawned
-        except OSError:
-            return False  # no log at all yet: definitely still booting
+        return worker_logged_since_spawn(worker)
 
     @staticmethod
     def _resumed_step_since_spawn(worker: dict
                                   ) -> tuple[int, float | None] | None:
-        """``(step, record_time)`` to close this worker's recovery
-        episode with, or None if it has not provably resumed. Log
-        mtime moving since the worker's own (re)spawn is necessary but
-        NOT sufficient: a restarted trainer journals its ``event:
-        "compile"`` record before its first step, and an adopted logdir
-        still carries the previous incarnation's step records — closing
-        on either would journal a resume with a stale step and count a
-        worker that wedged right after boot as recovered. Only the
-        newest intact record being a STEP record (appended since spawn,
-        so it is this incarnation's) is a first-moved-step; its own
-        ``time`` stamp (when the step happened, vs when this sweep
-        observed it) is what MTTR closes on."""
-        if not ChaosCampaign._logged_since_spawn(worker):
-            return None
-        log = Path(worker["logdir"]) / "train_log.jsonl"
-        try:
-            with open(log, "rb") as fh:
-                fh.seek(0, 2)
-                fh.seek(max(0, fh.tell() - 8192))
-                lines = fh.read().decode("utf-8", "replace").splitlines()
-        except OSError:
-            return None
-        for ln in reversed(lines):
-            if not ln.strip():
-                continue
-            try:
-                rec = json.loads(ln)
-            except ValueError:
-                # torn newest write: the next-intact record behind it
-                # may belong to the PREVIOUS incarnation (the torn line
-                # is what moved the mtime) — closing on it would
-                # journal a stale-step resume. Wait for the line to
-                # complete on a later tick; a worker killed mid-append
-                # stays open and is counted in unrecovered.
-                return None
-            if not isinstance(rec, dict):
-                return None
-            if rec.get("event", "step") != "step":
-                return None  # newest intact record: compile, not a step
-            step = rec.get("step")
-            if not isinstance(step, int):
-                return None
-            t = rec.get("time")
-            return step, (t if isinstance(t, (int, float)) else None)
-        return None
+        return worker_resumed_step_since_spawn(worker)
 
     def _drain(self, cluster: LocalProcessCluster,
                sup: ClusterSupervisor | None = None) -> None:
@@ -605,7 +646,9 @@ class ChaosCampaign:
             schedule = generate_schedule(
                 cfg.seed, t, cfg.num_workers, cfg.step_window(),
                 max_faults=cfg.max_faults, min_faults=cfg.min_faults,
-                stall_ms_range=cfg.resolved_stall_ms_range())
+                stall_ms_range=cfg.resolved_stall_ms_range(),
+                resize_worlds=cfg.resolved_resize_worlds(),
+                resize_prob=cfg.resize_prob)
             logger.info("chaos trial %d/%d: %s", t + 1, cfg.trials,
                         schedule.describe())
             rel = f"trial{t:03d}"
@@ -631,6 +674,14 @@ class ChaosCampaign:
                    "boot_s": outcome.get("boot_s"),
                    "stall_timeout_s": (outcome.get("supervisor") or {})
                    .get("stall_timeout_s"),
+                   # scheduled vs actually-fired: a fault that never
+                   # landed (kill after run-end) must be visible, not
+                   # silently green
+                   "faults": count_fired_faults(cfg.root / rel, schedule),
+                   # elastic world reshapes this trial performed
+                   "reconfigures": ((outcome.get("recovery") or {})
+                                    .get("reconfigure") or {}).get("count", 0),
+                   "final_world": outcome.get("final_world"),
                    "verdicts": check["verdicts"],
                    "violations": check["violations"]}
             if check["violations"] and cfg.shrink and reproducer is None:

@@ -74,6 +74,69 @@ def parse_poll_output(text: str | None) -> dict[str, Any]:
     return {"step": -1, "record": None}
 
 
+def worker_logged_since_spawn(worker: dict) -> bool:
+    """Has this worker appended to its own train_log.jsonl since its
+    CURRENT incarnation spawned? False means it is still booting (a
+    restarted jax worker spends ~15-30 s before its first log line).
+    ``worker`` is a status()/state entry carrying ``logdir`` and
+    ``spawned_at``; an unknown spawn time (pre-``spawned_at`` state
+    files) reads as True — the legacy behavior. Shared by the chaos
+    drain and the supervisor's reconfigure-resume watch."""
+    spawned = worker.get("spawned_at")
+    if spawned is None:
+        return True
+    log = Path(worker["logdir"]) / "train_log.jsonl"
+    try:
+        return log.stat().st_mtime >= spawned
+    except OSError:
+        return False  # no log at all yet: definitely still booting
+
+
+def worker_resumed_step_since_spawn(worker: dict
+                                    ) -> tuple[int, float | None] | None:
+    """``(step, record_time)`` proving this worker's CURRENT
+    incarnation produced a training step, or None if it has not
+    provably resumed. Log mtime moving since the worker's own
+    (re)spawn is necessary but NOT sufficient: a restarted trainer
+    journals its ``event: "compile"`` record before its first step,
+    and an adopted logdir still carries the previous incarnation's
+    step records — closing on either would journal a resume with a
+    stale step and count a worker that wedged right after boot as
+    recovered. Only the newest intact record being a STEP record
+    (appended since spawn, so it is this incarnation's) is a
+    first-moved-step; its own ``time`` stamp (when the step happened,
+    vs when this sweep observed it) is what MTTR-style latencies close
+    on. A torn newest line returns None — the next-intact record
+    behind it may belong to the previous incarnation; wait a tick."""
+    if not worker_logged_since_spawn(worker):
+        return None
+    log = Path(worker["logdir"]) / "train_log.jsonl"
+    try:
+        with open(log, "rb") as fh:
+            fh.seek(0, 2)
+            fh.seek(max(0, fh.tell() - 8192))
+            lines = fh.read().decode("utf-8", "replace").splitlines()
+    except OSError:
+        return None
+    for ln in reversed(lines):
+        if not ln.strip():
+            continue
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            return None  # torn newest write — cannot prove resume yet
+        if not isinstance(rec, dict):
+            return None
+        if rec.get("event", "step") != "step":
+            return None  # newest intact record: compile, not a step
+        step = rec.get("step")
+        if not isinstance(step, int):
+            return None
+        t = rec.get("time")
+        return step, (t if isinstance(t, (int, float)) else None)
+    return None
+
+
 class ClusterBackend(abc.ABC):
     """The lifecycle contract every backend realizes (≙ the reference's
     11-subcommand dispatch, tools/tf_ec2.py:828-856, as an interface)."""
@@ -113,6 +176,14 @@ class ClusterBackend(abc.ABC):
     def restart_worker(self, k: int) -> None:
         raise NotImplementedError(
             f"{type(self).__name__} cannot restart individual workers")
+
+    # elastic verb (ROADMAP item 2): reshape the cluster's world
+    # WITHOUT spawning — the supervisor drains before and relaunches
+    # after. Non-abstract: backends without it simply aren't elastic.
+    def reconfigure(self, new_num_workers: int,
+                    survivors: list[int] | None = None) -> dict[str, Any]:
+        raise NotImplementedError(
+            f"{type(self).__name__} cannot reconfigure its world size")
 
 
 # ---------------------------------------------------------------------------
@@ -560,6 +631,157 @@ class LocalProcessCluster(ClusterBackend):
         state["phase"] = "running"
         self._write_state(state)
 
+    def stop_all(self, worker: str = "all") -> None:
+        """Graceful drain: SIGTERM the worker process groups. A
+        preemption-aware payload (`launch train`,
+        train.handle_preemption) finishes its step, flushes a
+        checkpoint, and exits resumable — the checkpoint-flush half of
+        an elastic reconfigure. Callers bound the wait with
+        :meth:`wait_drained` and fall back to :meth:`kill_all` for
+        stragglers."""
+        state = self._read_state()
+        for w in self._select(state["workers"], worker):
+            if w.get("pid"):
+                pid = w["pid"]
+                self.exec.run(
+                    ["sh", "-c", f"kill -TERM -{pid} 2>/dev/null || "
+                                 f"kill -TERM {pid} 2>/dev/null || true"],
+                    verb="stop", check=False)
+
+    def _group_live_count(self, pid: int) -> int:
+        """Non-zombie processes still in ``pid``'s process group. The
+        recorded pid is the ``sh -c`` LEADER (start_new_session=True
+        makes it the pgid) and dash FORKS the payload: on a group
+        SIGTERM the leader dies instantly while the python trainer is
+        still flushing its preemption checkpoint — ``kill -0 <leader>``
+        reads "drained" mid-flush and the straggler SIGKILL would land
+        on the half-written save. Group membership is the truth a drain
+        must wait on (zombies excluded: an exited-but-unreaped leader
+        is not still flushing anything)."""
+        probe = self.exec.run(
+            ["sh", "-c", f"ps -eo pgid=,stat= | "
+                         f"awk '$1 == {pid} && $2 !~ /Z/' | wc -l"],
+            verb="status", check=False, max_attempts=1)
+        if probe is None or probe.returncode != 0:
+            return 0
+        try:
+            return int((probe.stdout or "").strip())
+        except ValueError:
+            return 0
+
+    def wait_drained(self, timeout_s: float,
+                     poll_secs: float = 0.5) -> bool:
+        """Block until every worker's process GROUP has fully exited —
+        leader AND all forked descendants — or the deadline passes.
+        Returns True when fully drained. This is what makes
+        ``stop_all`` → straggler-kill safe: only a group that kept
+        members past the deadline eats the SIGKILL."""
+        state = self._read_state()
+        pids = [w["pid"] for w in state["workers"] if w.get("pid")]
+        deadline = time.monotonic() + timeout_s
+        while True:
+            pids = [p for p in pids if self._group_live_count(p) > 0]
+            if not pids:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_secs)
+
+    # -- elastic world-size reconfiguration (ROADMAP item 2) ------------
+
+    def reconfigure(self, new_num_workers: int,
+                    survivors: list[int] | None = None) -> dict[str, Any]:
+        """Reshape the roster WITHOUT spawning: shrink keeps the named
+        ``survivors`` (logdirs, checkpoints, and worker ids untouched —
+        ids need not stay contiguous, every verb iterates the roster),
+        grow appends fresh ids whose logdirs are SEEDED with the first
+        survivor's newest checkpoint artifacts so the new worker
+        resumes at the last loadable step instead of step 0. The
+        caller (the supervisor's :meth:`~.supervisor.ClusterSupervisor.
+        reconfigure`) drains before and relaunches after; anything not
+        surviving is killed here. Journaled as an
+        ``event: "reconfigure"`` record — the causal license the
+        cross-world resume invariant requires — and returned."""
+        if new_num_workers < 1:
+            raise ClusterError(
+                f"reconfigure to {new_num_workers} workers: a cluster "
+                "needs at least one")
+        state = self._read_state()
+        workers = state["workers"]
+        if not workers:
+            raise ClusterError("reconfigure before create: no workers")
+        old_ids = [w["worker"] for w in workers]
+        if survivors is None:
+            survivors = old_ids[:new_num_workers]
+        keep_set = set(survivors)
+        unknown = keep_set - set(old_ids)
+        if unknown:
+            raise ClusterError(f"reconfigure: unknown survivor ids "
+                               f"{sorted(unknown)} (roster: {old_ids})")
+        if len(keep_set) > new_num_workers:
+            raise ClusterError(
+                f"reconfigure: {len(keep_set)} survivors > new world "
+                f"{new_num_workers}")
+        keep = [w for w in workers if w["worker"] in keep_set]
+        dropped = [w for w in workers if w["worker"] not in keep_set]
+        for w in dropped:  # nothing outside the new world may keep running
+            if w.get("pid"):
+                self._kill_pid(w["pid"], "kill")
+            w["pid"] = None
+        grown: dict[int, int] = {}
+        next_id = (max(old_ids) + 1) if old_ids else 0
+        seed_from = keep[0]["worker"] if keep else None
+        while len(keep) < new_num_workers:
+            k = next_id
+            next_id += 1
+            logdir = self.cfg.worker_dir(k)
+            nw = {"worker": k, "pid": None, "logdir": str(logdir)}
+            if not self.exec.dry_run:
+                logdir.mkdir(parents=True, exist_ok=True)
+            if seed_from is not None:
+                # seed the grown worker's resume point: the survivor's
+                # NEWEST checkpoint artifacts (resolved via the
+                # checkpoint.json pointer — copying every retained
+                # cadence save would multiply disk per grown worker
+                # and leave stale steps as silent fallback candidates);
+                # payloads without a pointer (the shell loops' bare
+                # `ckpt` file) fall back to the glob
+                src = next(w2["logdir"] for w2 in keep
+                           if w2["worker"] == seed_from)
+                pattern = "ckpt*"
+                try:
+                    ptr = json.loads(
+                        (Path(src) / "checkpoint.json").read_text())
+                    step = int(ptr["latest_step"])
+                    pattern = f"ckpt-{step:08d}*"
+                except (OSError, ValueError, KeyError, TypeError):
+                    pass
+                self.exec.run(
+                    ["sh", "-c",
+                     f"cp -p {shlex.quote(src)}/{pattern} "
+                     f"{shlex.quote(str(logdir))}/ 2>/dev/null; "
+                     f"cp -p {shlex.quote(src)}/checkpoint.json "
+                     f"{shlex.quote(str(logdir))}/ 2>/dev/null; true"],
+                    verb="reconfigure", check=False)
+                grown[k] = seed_from
+            keep.append(nw)
+        state["workers"] = keep
+        self.cfg = dataclasses.replace(self.cfg,
+                                       num_workers=new_num_workers)
+        self._write_state(state)
+        rec = {"event": "reconfigure", "layer": "cluster",
+               "action": "reshape",
+               "old_world": len(old_ids), "new_world": new_num_workers,
+               "old_workers": old_ids,
+               "workers": [w["worker"] for w in keep],
+               "dropped": [w["worker"] for w in dropped],
+               "grown": {str(k): v for k, v in grown.items()}}
+        self.exec.journal(rec)
+        logger.info("reconfigured cluster %s: %d -> %d workers "
+                    "(dropped %s, grown %s)", self.cfg.name, len(old_ids),
+                    new_num_workers, rec["dropped"], sorted(grown))
+        return rec
+
     # -- warm standbys (ROADMAP item 5) ---------------------------------
 
     def _spawn_standby(self, state: dict[str, Any]) -> dict[str, Any]:
@@ -1000,7 +1222,7 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("action",
                    choices=["create", "delete", "status", "run", "kill-all",
                             "exec", "download", "poll", "supervise",
-                            "chaos"])
+                            "reconfigure", "chaos"])
     p.add_argument("--backend", default="local", choices=["local", "gcloud"])
     p.add_argument("--config", default=None,
                    help="LocalClusterConfig / PodConfig JSON")
@@ -1044,6 +1266,19 @@ def main(argv: list[str] | None = None) -> None:
                    help="for supervise/chaos: keep N pre-booted, "
                         "precompiled standby processes parked; a due "
                         "restart promotes one instead of cold-starting")
+    p.add_argument("--elastic", action="store_true", default=None,
+                   help="for supervise: below quorum with every restart "
+                        "budget exhausted, SHRINK the world to the "
+                        "survivors (drain → checkpoint-flush → relaunch "
+                        "smaller, quorum rescaled) instead of aborting")
+    p.add_argument("--min-workers", type=int, default=None,
+                   help="for supervise: smallest world elastic shrink "
+                        "may produce (below it the run aborts)")
+    p.add_argument("--new-workers", type=int, default=None, metavar="M",
+                   help="for reconfigure: the target world size (shrink "
+                        "drops the highest ids / dead workers first; "
+                        "grow seeds fresh workers from a survivor's "
+                        "newest checkpoint)")
     p.add_argument("--seed", type=int, default=None,
                    help="for supervise/chaos: schedule + retry-jitter "
                         "seed, stamped on every journaled recovery/chaos "
@@ -1125,10 +1360,12 @@ def main(argv: list[str] | None = None) -> None:
                 timeout_secs=args.poll_timeout_s)))
         else:
             backend.run_train()
-    elif args.action == "supervise":
+    elif args.action in ("supervise", "reconfigure"):
         from .supervisor import ClusterSupervisor, SupervisorConfig
-        if args.until_step is None:
+        if args.action == "supervise" and args.until_step is None:
             p.error("supervise requires --until-step")
+        if args.action == "reconfigure" and args.new_workers is None:
+            p.error("reconfigure requires --new-workers")
         scfg = (SupervisorConfig.from_file(args.supervisor_config)
                 if args.supervisor_config else SupervisorConfig())
         overrides = {"quorum": args.quorum,
@@ -1136,13 +1373,31 @@ def main(argv: list[str] | None = None) -> None:
                      "restart_backoff_s": args.restart_backoff_s,
                      "stall_timeout_s": args.stall_timeout_s,
                      "standby_workers": args.standby_workers,
+                     "elastic": args.elastic,
+                     "min_workers": args.min_workers,
                      "seed": args.seed}
         scfg = dataclasses.replace(
             scfg, **{k: v for k, v in overrides.items() if v is not None})
         sup = ClusterSupervisor(backend, scfg)
-        print(json.dumps(sup.run_until_step(
-            args.until_step, poll_secs=poll_secs,
-            timeout_secs=args.poll_timeout_s)))
+        if args.action == "reconfigure":
+            # drain → reshape → relaunch; optionally supervise the
+            # resized world to a target step in the same invocation
+            rec = sup.reconfigure(args.new_workers, trigger="cli")
+            if args.until_step is not None:
+                try:
+                    got = sup.supervise_until_step(
+                        args.until_step, poll_secs=poll_secs,
+                        timeout_secs=args.poll_timeout_s)
+                finally:
+                    backend.kill_all()
+                print(json.dumps({"reconfigure": rec, **got}))
+            else:
+                print(json.dumps({"reconfigure": rec,
+                                  "summary": sup.summary()}))
+        else:
+            print(json.dumps(sup.run_until_step(
+                args.until_step, poll_secs=poll_secs,
+                timeout_secs=args.poll_timeout_s)))
     elif args.action == "poll":
         if args.until_step is not None:
             print(json.dumps(wait_until_step(

@@ -124,6 +124,14 @@ class FaultPlan:
                                          restart-vs-wait race against a
                                          supervisor's stall timeout is
                                          only testable with this one
+    ``resize_world_at_step`` [s, m]    — once the SUPERVISED run
+                                         observes step >= ``s``, the
+                                         supervisor reconfigures the
+                                         cluster to ``m`` workers (the
+                                         elastic shrink/grow fault —
+                                         cluster-level, executed by
+                                         ``ClusterSupervisor``, not the
+                                         backend's poll hook)
 
     Every action fires at most once per worker per run.
     """
@@ -139,6 +147,8 @@ class FaultPlan:
     # {worker: (trigger_step, stall_duration_ms)}
     stall_worker_for_ms_at_step: dict[int, tuple[int, float]] = \
         dataclasses.field(default_factory=dict)
+    # (trigger_step, new_world) — None = no resize fault armed
+    resize_world_at_step: tuple[int, int] | None = None
 
     _WORKER_KEYED = ("kill_worker_at_step", "hang_worker_at_step",
                      "corrupt_latest_checkpoint_at_step")
@@ -161,6 +171,9 @@ class FaultPlan:
             d["stall_worker_for_ms_at_step"] = {
                 int(k): (int(v[0]), float(v[1]))
                 for k, v in d["stall_worker_for_ms_at_step"].items()}
+        if d.get("resize_world_at_step") is not None:
+            v = d["resize_world_at_step"]
+            d["resize_world_at_step"] = (int(v[0]), int(v[1]))
         return cls(**d)
 
     def to_json_dict(self) -> dict:
@@ -176,7 +189,7 @@ class FaultPlan:
                 out[f.name] = {str(k): (list(v) if isinstance(v, tuple)
                                         else v) for k, v in val.items()}
             else:
-                out[f.name] = val
+                out[f.name] = list(val) if isinstance(val, tuple) else val
         return out
 
     def should_fail(self, verb: str, attempt: int) -> bool:

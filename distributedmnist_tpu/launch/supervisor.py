@@ -44,7 +44,8 @@ from pathlib import Path
 from typing import Any
 
 from ..core.log import get_logger
-from .cluster import ClusterBackend, ClusterError
+from .cluster import (ClusterBackend, ClusterError,
+                      worker_resumed_step_since_spawn)
 
 logger = get_logger("supervisor")
 
@@ -81,12 +82,42 @@ class SupervisorConfig:
     # the artifact alone — the seed regenerates the fault schedule and
     # the jitter sequence that produced it. None = unseeded run.
     seed: int | None = None
+    # -- elastic world-size reconfiguration (ROADMAP item 2) ----------
+    # Below quorum with every restart budget exhausted, an elastic run
+    # RESHAPES instead of aborting: survivors are drained (SIGTERM →
+    # checkpoint flush), the backend roster shrinks to them, quorum
+    # rescales (see rescaled_quorum), and the run relaunches as the
+    # smaller world resuming from the last loadable step. Off by
+    # default — aborting is the safe answer when nobody opted in.
+    elastic: bool = False
+    # smallest world an elastic shrink may produce; fewer survivors
+    # than this aborts exactly as a non-elastic run would
+    min_workers: int = 1
+    # bound on reconfigures per supervised run (a crash-looping world
+    # must not shrink one worker at a time forever)
+    max_reconfigures: int = 2
+    # how long a graceful drain (SIGTERM → flush → exit) may take
+    # before stragglers are killed outright
+    reconfigure_drain_s: float = 30.0
 
     def __post_init__(self) -> None:
         if self.quorum < 1:
             raise ClusterError(f"quorum must be >= 1, got {self.quorum}")
         if self.max_restarts_per_worker < 0:
             raise ClusterError("max_restarts_per_worker must be >= 0")
+        if self.min_workers < 1:
+            raise ClusterError(f"min_workers must be >= 1, "
+                               f"got {self.min_workers}")
+
+    def rescaled_quorum(self, new_world: int) -> int:
+        """The effective quorum for a resized world, clamped into
+        ``[1, new_world]``: a 3→2 shrink with quorum=3 must not abort
+        the instant it relaunches (the quorum was specified against
+        the OLD world). Journaled on every reconfigure so the policy
+        actually applied is artifact-visible; re-specify explicitly by
+        supervising the resized cluster with a fresh config if a
+        different policy is wanted."""
+        return max(1, min(self.quorum, new_world))
 
     @classmethod
     def from_file(cls, path: str | Path) -> "SupervisorConfig":
@@ -124,20 +155,39 @@ class ClusterSupervisor:
         self._watch_resume: set[int] = set()
         self._detect_t: dict[int, float] = {}
         self._respawn_t: dict[int, float] = {}
+        # open world-reshape transition: set by reconfigure(), closed
+        # (with the drain→first-moved-step latency) when a relaunched
+        # worker's OWN first step record lands — the MTTR analogue for
+        # a world change. Survives into supervise_until_step so a
+        # manual reconfigure-then-supervise flow still closes it.
+        self._reconf_open: dict[str, Any] | None = None
+        self.reconfigures = 0
 
     # -- event plumbing -------------------------------------------------
 
-    def _event(self, action: str, **fields: Any) -> None:
-        rec = {"event": "recovery", "layer": "supervisor",
-               "action": action, "time": time.time(), **fields}
+    def _record(self, rec: dict[str, Any]) -> None:
         if self.cfg.seed is not None:
             rec.setdefault("seed", self.cfg.seed)
         self.events.append(rec)
-        logger.info("recovery: %s %s", action,
-                    {k: v for k, v in fields.items() if k != "time"})
         ex = getattr(self.backend, "exec", None)
         if ex is not None and hasattr(ex, "journal"):
             ex.journal(rec)
+
+    def _event(self, action: str, **fields: Any) -> None:
+        logger.info("recovery: %s %s", action,
+                    {k: v for k, v in fields.items() if k != "time"})
+        self._record({"event": "recovery", "layer": "supervisor",
+                      "action": action, "time": time.time(), **fields})
+
+    def _reconf_event(self, action: str, **fields: Any) -> None:
+        """World-reshape transitions get their OWN journal event type
+        (``event: "reconfigure"``) — the causal license the chaos
+        cross-world resume invariant requires: a run whose world
+        changed without one of these fails replay."""
+        logger.info("reconfigure: %s %s", action,
+                    {k: v for k, v in fields.items() if k != "time"})
+        self._record({"event": "reconfigure", "layer": "supervisor",
+                      "action": action, "time": time.time(), **fields})
 
     def _mttr_fields(self, k: int, at: float | None = None
                      ) -> dict[str, Any]:
@@ -185,10 +235,171 @@ class ClusterSupervisor:
     def summary(self) -> dict[str, Any]:
         """Aggregate this run's recovery episode — the SAME aggregation
         ``obsv.journal.summarize_recovery`` applies to the journal,
-        over the in-memory events, plus the live restart counters."""
-        from ..obsv.journal import summarize_recovery_events
-        return {**summarize_recovery_events(self.events),
-                "restarts_by_worker": dict(self._restarts)}
+        over the in-memory events, plus the live restart counters and
+        any world-reshape transitions."""
+        from ..obsv.journal import (summarize_reconfigure_events,
+                                    summarize_recovery_events)
+        recovery = [e for e in self.events
+                    if e.get("event", "recovery") == "recovery"]
+        out = {**summarize_recovery_events(recovery),
+               "restarts_by_worker": dict(self._restarts)}
+        reconf = [e for e in self.events
+                  if e.get("event") == "reconfigure"]
+        if reconf:
+            out["reconfigure"] = summarize_reconfigure_events(reconf)
+        return out
+
+    # -- elastic world-size reconfiguration (ROADMAP item 2) ------------
+
+    def _can_reconfigure(self) -> bool:
+        """Whether the backend actually OVERRIDES the elastic verb.
+        ``hasattr`` is useless here — the base class defines
+        ``reconfigure`` (raising NotImplementedError), and discovering
+        that AFTER draining the survivors would turn a clean
+        below-quorum abort into a dead cluster with no journal."""
+        fn = getattr(type(self.backend), "reconfigure", None)
+        return (callable(fn)
+                and fn is not ClusterBackend.reconfigure)
+
+    def reconfigure(self, new_num_workers: int, trigger: str = "manual",
+                    survivors: list[int] | None = None,
+                    poll_secs: float = 0.5) -> dict[str, Any]:
+        """Drain → reshape → relaunch: the cluster resizes itself
+        instead of aborting (the TF-Replicator ending of the source
+        paper's backup-workers story).
+
+        1. **Drain**: live workers get SIGTERM (``stop_all``) — a
+           preemption-aware trainer finishes its step, flushes a
+           checkpoint, and exits resumable; stragglers past
+           ``cfg.reconfigure_drain_s`` are killed (their latest cadence
+           save is the resume point).
+        2. **Reshape**: ``backend.reconfigure`` keeps the survivors
+           (shrink prefers LIVE workers when none are named) or grows
+           fresh seeded workers; quorum rescales per
+           ``cfg.rescaled_quorum`` and the effective value is
+           journaled.
+        3. **Relaunch**: grown slots promote a ready warm standby when
+           the backend has one; everything else respawns cold. Each
+           worker's own resume-from-checkpoint logic — the
+           mesh-portable ``restore_for_topology`` path — decides where
+           it continues.
+
+        Every transition is journaled as ``event: "reconfigure"``
+        (begin → relaunched → resume) with old/new world, trigger, and
+        the MTTR-style drain→first-moved-step latency closed by the
+        supervise loop (or :meth:`close_reconfigure`)."""
+        backend = self.backend
+        st = backend.status() or {"workers": []}
+        roster = st.get("workers", [])
+        old_world = len(roster)
+        if survivors is None:
+            if new_num_workers >= old_world:
+                survivors = [w["worker"] for w in roster]  # grow: keep all
+            else:
+                # shrink: prefer live workers, then lowest ids
+                alive_ids = sorted(w["worker"] for w in roster
+                                   if w.get("alive"))
+                dead_ids = sorted(w["worker"] for w in roster
+                                  if not w.get("alive"))
+                survivors = sorted(
+                    (alive_ids + dead_ids)[:new_num_workers])
+        t0 = time.time()
+        new_q = self.cfg.rescaled_quorum(new_num_workers)
+        # open recovery episodes are SUPERSEDED by the world reshape:
+        # the drain/relaunch below replaces any in-flight restart, so
+        # no per-worker resume will ever close them — journal the
+        # supersede so summarize_mttr files them as neither recovered
+        # nor unrecovered (the transition's own reconfigure_s carries
+        # the latency evidence from here on)
+        for k in sorted(self._watch_resume):
+            self._event("episode_superseded", worker=k,
+                        by="reconfigure", trigger=trigger)
+        self._watch_resume.clear()
+        self._detect_t.clear()
+        self._respawn_t.clear()
+        self._reconf_event("begin", old_world=old_world,
+                           new_world=new_num_workers, trigger=trigger,
+                           quorum=self.cfg.quorum, effective_quorum=new_q,
+                           survivors=sorted(survivors))
+        # (1) graceful drain, bounded. The wait must cover the whole
+        # process GROUP where the backend can tell (wait_drained): the
+        # recorded pid is a shell leader that dies to the group SIGTERM
+        # instantly while the trainer behind it is still flushing its
+        # preemption checkpoint — a status()-only wait would SIGKILL
+        # that flush mid-write and lose the resume point.
+        if hasattr(backend, "stop_all"):
+            backend.stop_all()
+            if hasattr(backend, "wait_drained"):
+                backend.wait_drained(self.cfg.reconfigure_drain_s,
+                                     poll_secs)
+            else:
+                deadline = time.monotonic() + self.cfg.reconfigure_drain_s
+                while time.monotonic() < deadline:
+                    st2 = backend.status()
+                    if st2 is None or not any(w.get("alive")
+                                              for w in st2["workers"]):
+                        break
+                    time.sleep(poll_secs)
+        # straggler kill is PER WORKER: kill_all("all") also reaps
+        # parked standbys, and the warm grow path below needs them
+        # alive to promote
+        if roster:
+            for w in roster:
+                backend.kill_all(worker=str(w["worker"]))
+        else:
+            backend.kill_all()
+        # (2) reshape + quorum rescale
+        rec = backend.reconfigure(new_num_workers, survivors=survivors)
+        if new_q != self.cfg.quorum:
+            self.cfg = dataclasses.replace(self.cfg, quorum=new_q)
+        # (3) relaunch — standbys first for GROWN slots (the warm grow
+        # path: a parked, precompiled spare adopts the seeded logdir)
+        grown = {int(k) for k in (rec.get("grown") or {})}
+        via: dict[int, str] = {}
+        for k in rec.get("workers", []):
+            promoted = False
+            if k in grown and hasattr(backend, "promote_standby"):
+                try:
+                    promoted = bool(backend.promote_standby(k))
+                except Exception as e:
+                    if not isinstance(e, NotImplementedError):
+                        logger.warning(
+                            "standby promotion for grown worker %d "
+                            "failed (%s: %s) — cold spawn", k,
+                            type(e).__name__, e)
+                    promoted = False
+            if not promoted:
+                backend.restart_worker(k)
+            via[k] = "standby" if promoted else "respawn"
+        drain_s = round(time.time() - t0, 3)
+        self._reconf_event("relaunched", old_world=old_world,
+                           new_world=new_num_workers, trigger=trigger,
+                           drain_s=drain_s, workers=sorted(via),
+                           via={str(k): v for k, v in via.items()},
+                           grown=sorted(grown))
+        self.reconfigures += 1
+        self._reconf_open = {"t0": t0, "old_world": old_world,
+                             "new_world": new_num_workers,
+                             "trigger": trigger, "workers": set(via)}
+        return rec
+
+    def close_reconfigure(self, k: int, step: int | None = None,
+                          at: float | None = None) -> None:
+        """Journal the ``resume`` closing the open reconfigure
+        transition: the FIRST relaunched worker whose own step record
+        lands defines the drain→first-moved-step latency (the world
+        change is over once the resized world trains). No-op without
+        an open transition."""
+        ro = self._reconf_open
+        if not ro or k not in ro["workers"]:
+            return
+        now = time.time() if at is None else at
+        self._reconf_open = None
+        self._reconf_event("resume", worker=k, step=step,
+                           old_world=ro["old_world"],
+                           new_world=ro["new_world"],
+                           trigger=ro["trigger"],
+                           reconfigure_s=round(now - ro["t0"], 3))
 
     # -- the supervised run ---------------------------------------------
 
@@ -215,11 +426,36 @@ class ClusterSupervisor:
         last_progress: dict[int, int] = {}
         last_progress_t: dict[int, float] = {}
         # fresh episode state per supervised run (instance-level so a
-        # post-run caller can close episodes the run left open)
+        # post-run caller can close episodes the run left open);
+        # _reconf_open deliberately survives — a manual reconfigure
+        # followed by supervise still closes its transition here
         self._watch_resume = set()
         self._detect_t = {}
         self._respawn_t = {}
         watch_resume = self._watch_resume
+
+        # the elastic resize fault (FaultPlan.resize_world_at_step):
+        # cluster-level, so the SUPERVISOR executes it — the backend's
+        # poll hook only sees single workers
+        resize: tuple[int, int] | None = None
+        ex = getattr(self.backend, "exec", None)
+        if ex is not None and getattr(ex, "fault_plan", None) is not None:
+            resize = ex.fault_plan.resize_world_at_step
+        resize_fired = False
+
+        def reset_roster_state() -> None:
+            """After a reconfigure the roster changed under the loop:
+            every per-worker tracker restarts from the relaunched
+            world's own observations (a survivor's pre-drain log tail
+            must not read as progress, a dropped worker's exhausted
+            budget must not linger)."""
+            nonlocal last_alive
+            pending_restart.clear()
+            exhausted.clear()
+            last_progress.clear()
+            last_progress_t.clear()
+            watch_resume.clear()
+            last_alive = None
 
         if (cfg.standby_workers > 0
                 and hasattr(self.backend, "ensure_standbys")):
@@ -286,6 +522,26 @@ class ClusterSupervisor:
                             # the restarted worker's own log moved: THIS
                             # step (not worker 0's) is where it resumed
                             self.close_episode(k, step_k)
+            # ---- open reconfigure transition: first-moved-step -------
+            if self._reconf_open is not None:
+                snapshot = got.get("workers") or []
+                closed_by_log = False
+                for w in snapshot:
+                    if (w.get("worker") in self._reconf_open["workers"]
+                            and w.get("logdir")):
+                        closed_by_log = True
+                        r = worker_resumed_step_since_spawn(w)
+                        if r is not None:
+                            self.close_reconfigure(w["worker"], *r)
+                            break
+                if not closed_by_log and progress is not None:
+                    # backends without logdir evidence (scripted tests):
+                    # any tracked worker's log movement counts
+                    for k in sorted(moved):
+                        if (k in self._reconf_open["workers"]
+                                and progress.get(k, -1) >= 0):
+                            self.close_reconfigure(k, progress[k])
+                            break
             best_step = got["step"]
             if progress:
                 best_step = max(best_step, *progress.values())
@@ -306,6 +562,19 @@ class ClusterSupervisor:
                 got["step"] = best_step
                 got["recovery"] = self.summary()
                 return got
+            # ---- elastic resize fault (after the target check: a run
+            # that already finished does not resize) -------------------
+            if (resize is not None and not resize_fired
+                    and best_step >= resize[0]):
+                resize_fired = True
+                if (self.reconfigures < cfg.max_reconfigures
+                        and self._can_reconfigure()):
+                    self.reconfigure(resize[1], trigger="fault_plan",
+                                     poll_secs=min(poll_secs, 0.5))
+                    cfg = self.cfg  # quorum may have rescaled
+                    reset_roster_state()
+                    time.sleep(poll_secs)
+                    continue
             # reuse the liveness snapshot poll() already took this tick
             # (LocalProcessCluster attaches it); only backends that
             # don't get the separate status() sweep
@@ -404,6 +673,23 @@ class ClusterSupervisor:
             # would kill the run right after the restart that saved it
             if (workers and n_alive < cfg.quorum
                     and not pending_restart and not watch_resume):
+                # elastic shrink: permanent capacity loss reshapes the
+                # world to the survivors instead of degraded-quorum
+                # forever / an abort — the cluster resizes itself
+                survivors = sorted(k for k, a in alive.items() if a)
+                if (cfg.elastic
+                        and self.reconfigures < cfg.max_reconfigures
+                        and len(survivors) >= cfg.min_workers
+                        and len(survivors) < len(alive)
+                        and self._can_reconfigure()):
+                    self.reconfigure(len(survivors),
+                                     trigger="below_quorum",
+                                     survivors=survivors,
+                                     poll_secs=min(poll_secs, 0.5))
+                    cfg = self.cfg  # quorum rescaled for the new world
+                    reset_roster_state()
+                    time.sleep(poll_secs)
+                    continue
                 self._event("below_quorum_abort", workers_alive=n_alive,
                             quorum=cfg.quorum)
                 raise ClusterError(

@@ -27,6 +27,19 @@ survived fault schedule must satisfy:
 5. **checkpoint_integrity** — every digest sidecar in the checkpoint
    dir verifies (deliberately-torn fault targets journaled by the
    injector are exempt) and the manifest pointer resolves.
+6. **reconfigure** — the cross-world resume invariant (elastic
+   shrink/grow): a run whose final roster differs from its launch
+   world must hold a journaled ``event: "reconfigure"`` record as the
+   causal LICENSE for the change (a silently-reshaped run fails
+   replay), the journaled transition must land on the world the
+   artifacts actually show, and post-resize metrics must splice
+   gap-free across the world change — each relaunch is an allowed
+   rewind for the workers it respawned, and a GROWN worker (seeded
+   from a survivor's checkpoint) may start its series mid-run. The
+   bitwise determinism claim (invariant 3) keeps applying across the
+   resize for the sync discipline: each local worker's compute is
+   world-size-independent, so a fully recovered resized trial still
+   reproduces the fault-free reference digest exactly.
 
 No cluster, supervisor, or trainer state is consulted — a report over
 downloaded artifacts is as checkable as a live run, which is what lets
@@ -44,7 +57,7 @@ from typing import Any, Callable
 from .report import load_jsonl
 
 INVARIANTS = ("terminal_state", "metrics_log", "determinism",
-              "causality", "checkpoint_integrity")
+              "causality", "checkpoint_integrity", "reconfigure")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -87,14 +100,18 @@ def splice_rollbacks(steps: list[dict]) -> tuple[list[dict], int]:
 
 
 def check_metrics_log(steps: list[dict], allowed_rewinds: int | None = None,
-                      worker: int | None = None) -> list[Violation]:
+                      worker: int | None = None,
+                      expect_first_step: int | None = 1) -> list[Violation]:
     """Invariant (2) over one worker's step records.
 
     ``allowed_rewinds``: how many rewinds the recovery journals justify
-    (restarts + NaN rollbacks). None skips the explanation check (a
-    bare log with no journal context). A rewind count EXCEEDING the
-    justified one is how a doctored/duplicated record — or a rollback
-    that re-emitted a window it already wrote — surfaces."""
+    (restarts + NaN rollbacks + reconfigure relaunches). None skips the
+    explanation check (a bare log with no journal context). A rewind
+    count EXCEEDING the justified one is how a doctored/duplicated
+    record — or a rollback that re-emitted a window it already wrote —
+    surfaces. ``expect_first_step``: where the spliced series must
+    begin; None waives it (a GROWN worker seeded from a survivor's
+    checkpoint legitimately starts mid-run)."""
     out: list[Violation] = []
     if not steps:
         return [Violation("metrics_log", "no step records at all", worker)]
@@ -105,11 +122,12 @@ def check_metrics_log(steps: list[dict], allowed_rewinds: int | None = None,
             f"{rewinds} rewind(s) in the step series but only "
             f"{allowed_rewinds} journaled recovery cause(s) — "
             "duplicated or re-emitted step records", worker))
-    if spliced and spliced[0]["step"] != 1:
+    if (spliced and expect_first_step is not None
+            and spliced[0]["step"] != expect_first_step):
         out.append(Violation(
             "metrics_log",
-            f"spliced series starts at step {spliced[0]['step']}, not 1 "
-            "(missing leading records)", worker))
+            f"spliced series starts at step {spliced[0]['step']}, not "
+            f"{expect_first_step} (missing leading records)", worker))
     for prev, rec in zip(spliced, spliced[1:]):
         if rec["step"] != prev["step"] + 1:
             out.append(Violation(
@@ -325,6 +343,80 @@ def determinism_verdict(logdir: str | Path, reference_dir: str | Path,
 
 
 # ---------------------------------------------------------------------------
+# (6) cross-world resume (elastic reconfigure)
+# ---------------------------------------------------------------------------
+
+def check_reconfigure(trial_dir: str | Path, outcome: dict,
+                      journal_records: list[dict]
+                      ) -> tuple[list[Violation], bool, set[int],
+                                 dict[int, int]]:
+    """Invariant (6) over the artifacts alone. Returns
+    ``(violations, applicable, grown_workers, relaunch_counts)`` —
+    ``applicable`` False when the run neither reshaped nor claims to
+    have (verdict: skipped); ``grown_workers`` are ids whose logdirs
+    were seeded mid-run (their metric series may start mid-run);
+    ``relaunch_counts`` maps worker → number of journaled reconfigure
+    relaunches that respawned it (each one licenses a log rewind).
+
+    The causal-license rule: the launch world is ``outcome
+    ["num_workers"]``; the final world is what the backend's
+    ``state.json`` artifact shows. A difference with NO journaled
+    ``event: "reconfigure"`` record fails — a run that silently
+    changed shape must not replay green. When reconfigure events DO
+    exist, the last journaled reshape must land on the world the
+    artifacts show."""
+    trial_dir = Path(trial_dir)
+    reconf = [r for r in journal_records
+              if r.get("event") == "reconfigure"]
+    reshapes = [r for r in reconf if r.get("action") == "reshape"]
+    relaunches = [r for r in reconf if r.get("action") == "relaunched"]
+    grown = {int(k) for r in reshapes for k in (r.get("grown") or {})}
+    relaunch_counts: dict[int, int] = {}
+    for r in (relaunches or reshapes):
+        for k in r.get("workers", []):
+            relaunch_counts[k] = relaunch_counts.get(k, 0) + 1
+
+    final_ids: list[int] | None = None
+    state_path = trial_dir / "state.json"
+    if state_path.exists():
+        try:
+            st = json.loads(state_path.read_text())
+            final_ids = sorted(int(w["worker"])
+                               for w in st.get("workers", []))
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+            final_ids = None
+    initial = outcome.get("num_workers")
+
+    out: list[Violation] = []
+    world_changed = (final_ids is not None and initial is not None
+                     and len(final_ids) != initial)
+    if world_changed and not reconf:
+        out.append(Violation(
+            "reconfigure",
+            f"world changed {initial} -> {len(final_ids)} workers "
+            f"(roster {final_ids}) with no journaled reconfigure event "
+            "— no causal license for the resize"))
+    if reshapes and final_ids is not None:
+        last = sorted(int(k) for k in reshapes[-1].get("workers", []))
+        if last and last != final_ids:
+            out.append(Violation(
+                "reconfigure",
+                f"journaled reconfigure lands on roster {last} but the "
+                f"artifacts show {final_ids} — the journal and the "
+                "cluster state disagree about the final world"))
+    # a trial that claims a final world must match the artifact too
+    claimed = outcome.get("final_world")
+    if (claimed is not None and final_ids is not None
+            and claimed != len(final_ids)):
+        out.append(Violation(
+            "reconfigure",
+            f"outcome claims final_world={claimed} but state.json shows "
+            f"{len(final_ids)} workers"))
+    applicable = bool(reconf) or world_changed
+    return out, applicable, grown, relaunch_counts
+
+
+# ---------------------------------------------------------------------------
 # whole-run replay
 # ---------------------------------------------------------------------------
 
@@ -396,6 +488,11 @@ def check_run(trial_dir: str | Path, outcome: dict | None = None,
 
     violations += check_terminal_state(outcome, recovery)
     violations += check_causality(recovery, worker_events)
+    reconf_violations, reconf_applicable, grown, relaunch_counts = \
+        check_reconfigure(trial_dir, outcome, journal_all)
+    violations += reconf_violations
+    if not reconf_applicable:
+        skipped.add("reconfigure")
 
     restarts_by_worker: dict[int, int] = {}
     for r in recovery:
@@ -411,12 +508,25 @@ def check_run(trial_dir: str | Path, outcome: dict | None = None,
         steps = [r for r in load_jsonl(d / "train_log.jsonl")
                  if isinstance(r.get("step"), int)
                  and r.get("event", "step") == "step"]
+        if k in grown and not steps:
+            # a grown worker that never produced a step before
+            # teardown has nothing to splice — its resume evidence is
+            # the reconfigure journal, not a log. Its SEEDED checkpoint
+            # dir still gets the integrity check: a source file copied
+            # while torn is exactly what invariant 5 exists to catch.
+            violations += check_checkpoint_dir(d, exempt.get(k, set()),
+                                               worker=k)
+            continue
         allowed = (restarts_by_worker.get(k, 0)
+                   + relaunch_counts.get(k, 0)
                    + sum(1 for r in worker_events.get(k, [])
                          if r.get("action") in ("nan_rollback",
                                                 "fallback_restore")))
-        violations += check_metrics_log(steps, allowed_rewinds=allowed,
-                                        worker=k)
+        violations += check_metrics_log(
+            steps, allowed_rewinds=allowed, worker=k,
+            # a grown worker's logdir was seeded mid-run: its series
+            # legitimately starts at the seed checkpoint's step
+            expect_first_step=None if k in grown else 1)
         violations += check_checkpoint_dir(d, exempt.get(k, set()), worker=k)
         if reference_dir is not None:
             checked, det_violations = determinism_verdict(

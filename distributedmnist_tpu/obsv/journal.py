@@ -28,6 +28,58 @@ def load_recovery_events(path: str | Path) -> list[dict]:
     return load_jsonl(path, event="recovery")
 
 
+def load_reconfigure_events(path: str | Path) -> list[dict]:
+    """Elastic world-reshape records (``event: "reconfigure"``) —
+    written by the supervisor (begin → relaunched → resume) and the
+    cluster backend (reshape) into the command journal. Their presence
+    is the causal LICENSE for a world change: the cross-world resume
+    invariant (obsv/invariants.py) fails a run whose world silently
+    changed shape without one."""
+    return load_jsonl(path, event="reconfigure")
+
+
+def summarize_reconfigure_events(records: list[dict]) -> dict[str, Any]:
+    """Aggregate reconfigure records into the transition evidence:
+    one entry per ``begin`` (old/new world, trigger, the quorum as
+    specified and as rescaled for the new world) folded with its
+    ``relaunched`` (drain latency, per-worker respawn-vs-standby) and
+    ``resume`` (drain→first-moved-step latency — the MTTR analogue
+    for a world change). Supervisor-less reshapes (a bare backend
+    ``reconfigure``) count as their own transitions."""
+    transitions: list[dict[str, Any]] = []
+    cur: dict[str, Any] | None = None
+    for r in records:
+        a = r.get("action")
+        if a == "begin":
+            cur = {"old_world": r.get("old_world"),
+                   "new_world": r.get("new_world"),
+                   "trigger": r.get("trigger"),
+                   "quorum": r.get("quorum"),
+                   "effective_quorum": r.get("effective_quorum"),
+                   "survivors": r.get("survivors")}
+            transitions.append(cur)
+        elif a == "reshape" and cur is None:
+            transitions.append({"old_world": r.get("old_world"),
+                                "new_world": r.get("new_world"),
+                                "trigger": "backend",
+                                "grown": r.get("grown")})
+        elif a == "relaunched" and cur is not None:
+            cur["drain_s"] = r.get("drain_s")
+            cur["via"] = r.get("via")
+            cur["grown"] = r.get("grown")
+        elif a == "resume" and cur is not None:
+            cur["reconfigure_s"] = r.get("reconfigure_s")
+            cur["first_moved_worker"] = r.get("worker")
+            cur["first_moved_step"] = r.get("step")
+            cur = None
+    return {"count": len(transitions), "transitions": transitions}
+
+
+def summarize_reconfigures(path: str | Path) -> dict[str, Any]:
+    """Load + aggregate the reconfigure events in one journal file."""
+    return summarize_reconfigure_events(load_reconfigure_events(path))
+
+
 def _percentile(sorted_vals: list[float], q: float) -> float:
     """Nearest-rank percentile over an already-sorted list."""
     idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
@@ -47,12 +99,19 @@ def summarize_mttr(records: list[dict]) -> dict[str, Any]:
     pending_detect: dict[int, float] = {}
     episodes: list[float] = []
     respawn: list[float] = []
+    superseded = 0
     by_worker: dict[int, list[float]] = {}
     for rec in records:
         action = rec.get("action")
         k = rec.get("worker")
         if action == "detect" and k is not None:
             pending_detect[k] = rec.get("time")
+        elif action == "episode_superseded" and k is not None:
+            # a world reshape (reconfigure) replaced the in-flight
+            # restart: the episode is neither recovered nor lost — the
+            # reconfigure transition's own latency covers it
+            if pending_detect.pop(k, None) is not None:
+                superseded += 1
         elif action == "resume" and k is not None:
             m = rec.get("mttr_s")
             if m is None:
@@ -70,7 +129,8 @@ def summarize_mttr(records: list[dict]) -> dict[str, Any]:
     # recovery to time) or a run torn down before the restarted worker
     # ever moved — surfaced instead of silently undercounting episodes
     out: dict[str, Any] = {"episodes": len(episodes),
-                           "unrecovered": len(pending_detect)}
+                           "unrecovered": len(pending_detect),
+                           "superseded": superseded}
     if episodes:
         s = sorted(episodes)
         out.update(mean_s=round(sum(s) / len(s), 3),
@@ -142,7 +202,16 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
     reproducers: list[str] = []
     mttr_trials: list[dict[str, Any]] = []
     mttr_all: list[float] = []
+    fault_trials: list[dict[str, Any]] = []
+    reconfigures = 0
     for rec in records:
+        f = rec.get("faults")
+        if f is not None:
+            fault_trials.append({"trial": rec.get("trial"),
+                                 "scheduled": f.get("scheduled", 0),
+                                 "fired": f.get("fired", 0),
+                                 "unfired": f.get("unfired", [])})
+        reconfigures += rec.get("reconfigures") or 0
         outcomes[rec.get("outcome", "?")] = (
             outcomes.get(rec.get("outcome", "?"), 0) + 1)
         for inv, verdict in (rec.get("verdicts") or {}).items():
@@ -186,6 +255,20 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
             "all_green": not failing and bool(records),
             "failing_trials": failing,
             "reproducers": reproducers,
+            # scheduled-vs-fired accounting: a kill that lands after
+            # run-end fires nothing — without this a zero-episode
+            # trial is indistinguishable from a real all-quiet run,
+            # and the nightly gate asserts the campaign actually
+            # FIRED something (fired > 0)
+            "faults": {
+                "scheduled": sum(t["scheduled"] for t in fault_trials),
+                "fired": sum(t["fired"] for t in fault_trials),
+                "never_fired": sum(len(t["unfired"])
+                                   for t in fault_trials),
+                "per_trial": fault_trials},
+            # elastic world reshapes across the campaign (the resize
+            # fault kind / below-quorum shrinks)
+            "reconfigures": reconfigures,
             # MTTR as a first-class campaign metric: detect→first-
             # moved-step latency over every recovery episode in every
             # trial (the chaos CI asserts this key exists and uploads

@@ -91,8 +91,21 @@ class ExecutableCache:
     that cannot deserialize foreign executables (marker written so
     later processes skip the probe)."""
 
-    def __init__(self, cache_dir: str | Path):
+    def __init__(self, cache_dir: str | Path,
+                 trust_cross_process: bool = False):
         self.dir = Path(cache_dir) / "aot"
+        # cross-process reuse is the cache's whole purpose AND the
+        # measured corruption vector on quarantined jax releases
+        # (core.compile_cache.cross_process_reuse_quarantined): both
+        # directions refuse there unless the caller asserts the
+        # platform was validated (compile.trust_cache_cross_process)
+        self.trust_cross_process = trust_cross_process
+
+    def _quarantined(self) -> str | None:
+        if self.trust_cross_process:
+            return None
+        from ..core.compile_cache import cross_process_reuse_quarantined
+        return cross_process_reuse_quarantined()
 
     def _entry(self, key: str) -> Path:
         return self.dir / f"{key}.exe"
@@ -149,6 +162,10 @@ class ExecutableCache:
         found" → marker). An in-process reload also has nothing to
         win — the live process recompiles through the warm persistent
         cache in well under a second."""
+        reason = self._quarantined()
+        if reason is not None:
+            logger.debug("AOT disk cache quarantined: %s", reason)
+            return None
         path = self._entry(key)
         if self.serialization_known_unsupported() or not path.exists():
             return None
@@ -180,6 +197,8 @@ class ExecutableCache:
         """Serialize ``compiled`` into the cache (atomic write);
         returns whether it was stored. Serialization failure marks the
         platform unsupported — same verdict as a failed load."""
+        if self._quarantined() is not None:
+            return False  # an entry nobody may safely load
         if self.serialization_known_unsupported():
             return False
         try:
@@ -210,7 +229,9 @@ class ExecutableCache:
 
 
 def aot_compile(jitted, args: tuple, cache_dir: str | Path | None = None,
-                key: str | None = None) -> tuple[Any, dict[str, Any]]:
+                key: str | None = None,
+                trust_cross_process: bool = False
+                ) -> tuple[Any, dict[str, Any]]:
     """Compile ``jitted`` for ``args`` ahead of time, through the
     executable disk cache when one is configured.
 
@@ -218,7 +239,7 @@ def aot_compile(jitted, args: tuple, cache_dir: str | Path | None = None,
     executable came from (``aot_disk`` / ``compiled``), the wall
     seconds it took, and whether it was (re)serialized to disk — the
     fields Trainer journals as the ``event: "compile"`` record."""
-    cache = (ExecutableCache(cache_dir)
+    cache = (ExecutableCache(cache_dir, trust_cross_process)
              if cache_dir is not None and key is not None else None)
     t0 = time.perf_counter()
     if cache is not None:

@@ -230,8 +230,80 @@ def init_train_state(model: Model, cfg: ExperimentConfig,
 
 
 # ---------------------------------------------------------------------------
-# canonical checkpoint layout (ZeRO-1 pack/unpack)
+# canonical checkpoint layout (ZeRO-1 pack/unpack) + mesh portability
 # ---------------------------------------------------------------------------
+
+def world_signature(topo: Topology) -> dict:
+    """The world a checkpoint is saved under — JSON-clean, stamped into
+    every artifact's ``extra["world"]`` (train/loop.py ``_save``) so a
+    restore can tell "same world, graft directly" from "resized world,
+    reshard" and name both sides in errors
+    (train/checkpoint.py ``WorldSizeMismatchError``). Only axes > 1
+    enter the mesh record, so a pure-DP world compares equal however
+    many size-1 axes the mesh spells out."""
+    return {"num_replicas": int(topo.num_replicas),
+            "process_count": int(jax.process_count()),
+            "mesh": {ax: int(topo.mesh.shape[ax])
+                     for ax in topo.mesh.axis_names
+                     if int(topo.mesh.shape[ax]) > 1}}
+
+
+def restore_for_topology(model: Model, cfg: ExperimentConfig,
+                         topo: Topology, train_dir, template_state: TrainState,
+                         step: int | None = None,
+                         on_event: Callable[[dict], None] | None = None,
+                         ) -> tuple[TrainState, dict, int] | None:
+    """Mesh-portable restore (ROADMAP item 2, TF-Replicator's
+    resource-shape-agnostic replicas): load an artifact saved under ANY
+    world size and reshard it for the CURRENT mesh.
+
+    Why this works without migration code:
+
+    * **Params / sharded (tp/pp) state** — checkpoints store logical
+      global arrays (the per-host sharded layout reassembles them from
+      every saver process's shard file regardless of the reader's
+      process count); the caller re-splits them per the NEW spec trees
+      by placing the result with ``Topology.device_put_state`` over
+      ``state_partition_specs`` — the rule engine derives those from
+      the current mesh, not the saver's.
+    * **ZeRO-1 optimizer state** — the canonical-layout contract
+      unpacks momentum to logical shapes on save, so restore re-derives
+      the :class:`~..parallel.partition_rules.Zero1Plan` (padding,
+      chunk ownership) from the NEW replica count and repacks; an
+      artifact that kept the flat layout (cross-process sharded saves)
+      carries a foreign ``pad`` and is re-padded exactly
+      (``zero1_pack`` truncates zero padding, never data).
+    * **Data cursor** — ``extra["data_iter"]`` carries the lockstep
+      ``batches`` coordinate plus the saver's world; the new world's
+      ``BatchIterator.restore`` reassigns it so no sample range is
+      dropped or double-visited (data/pipeline.py).
+
+    A world change is reported through ``on_event`` as
+    ``action: "cross_world_restore"`` naming both worlds — the
+    journaled evidence the chaos cross-world resume invariant pairs
+    with the supervisor's ``event: "reconfigure"`` license."""
+    from ..train import checkpoint as ckpt
+    restored = ckpt.restore_checkpoint(train_dir, template_state,
+                                       step=step, on_event=on_event)
+    if restored is None:
+        return None
+    state, extra, got_step = restored
+    # the plan (padding, chunk ownership) comes from the CURRENT
+    # replica count — never the saver's n
+    plan = zero1_plan_for(model, cfg, topo)
+    state = pack_restored_state(state, plan)
+    saved_world = (extra or {}).get("world")
+    current = world_signature(topo)
+    if isinstance(saved_world, dict) and saved_world != current:
+        logger.info("cross-world restore: checkpoint step=%d saved under "
+                    "world %s resharded onto %s", got_step, saved_world,
+                    current)
+        if on_event is not None:
+            on_event({"layer": "checkpoint",
+                      "action": "cross_world_restore", "step": got_step,
+                      "saved_world": saved_world, "new_world": current})
+    return state, extra, got_step
+
 
 def canonical_save_state(state: TrainState,
                          plan: Zero1Plan | None) -> TrainState:
@@ -793,18 +865,20 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
 
     def precompile(state: TrainState, batch: dict,
                    measured_ms: jax.Array | None = None,
-                   cache_dir=None, cache_key: str | None = None
-                   ) -> dict[str, Any]:
+                   cache_dir=None, cache_key: str | None = None,
+                   trust_cross_process: bool = False) -> dict[str, Any]:
         """AOT-compile the step for these exact avals (no execution, no
         donation — lowering only reads shapes) and arm the fast path.
         With a cache_dir+key, the executable round-trips the disk cache
-        where the platform supports it (parallel/aot.py)."""
+        where the platform supports it AND the jax release is outside
+        the cross-process corruption quarantine (parallel/aot.py)."""
         from . import aot as aot_lib
         if measured_ms is None:
             measured_ms = _default_measured()
         compiled, info = aot_lib.aot_compile(
             jitted, (state, batch, measured_ms),
-            cache_dir=cache_dir, key=cache_key)
+            cache_dir=cache_dir, key=cache_key,
+            trust_cross_process=trust_cross_process)
         aot_box["exe"] = compiled
         aot_box["sig"] = _args_sig((state, batch, measured_ms))
         return info

@@ -193,18 +193,45 @@ def zero1_init_state(params: Any, plan: Zero1Plan) -> Any:
 def zero1_pack(tree: Any, plan: Zero1Plan) -> Any:
     """Logical-shape tree → the plan's flattened-padded layout
     (host-side numpy; the restore direction of the canonical-checkpoint
-    contract). Already-packed leaves pass through, so restoring a
-    flat-layout artifact is also exact."""
+    contract). Already-packed leaves pass through, and a leaf packed
+    under a DIFFERENT replica count (``pad_old = ceil(size/n_old)·n_old``
+    — e.g. a cross-process sharded artifact restored onto a resized
+    mesh) is re-padded for THIS plan: padding is zeros by contract, so
+    truncating to the logical size and re-padding is exact. This is
+    what makes the restore side of the canonical contract
+    mesh-portable: the plan is always re-derived from the CURRENT
+    replica count (``parallel.api.restore_for_topology``), never the
+    saver's."""
     def pack(x: Any, lp: LeafShardPlan):
         if not lp.sharded:
             return x
         a = np.asarray(x)
         if a.shape == (lp.pad,):
-            return a  # already in the packed layout
-        a = a.reshape(-1)
-        if lp.pad != lp.size:
-            a = np.concatenate([a, np.zeros(lp.pad - lp.size, a.dtype)])
-        return a
+            return a  # already packed for THIS world
+        flat = a.reshape(-1)
+        if flat.size != lp.size:
+            if a.ndim != 1 or flat.size < lp.size:
+                # not a flat-packed layout of this leaf under ANY
+                # replica count — a genuine shape mismatch must stay
+                # loud, not be silently truncated into "fitting"
+                raise ValueError(
+                    f"cannot pack leaf of shape {a.shape} into shard "
+                    f"plan (logical {lp.shape}, {lp.size} elements, "
+                    f"pad {lp.pad})")
+            if np.any(flat[lp.size:]):
+                # padding is zeros BY CONTRACT — a non-zero tail means
+                # this is real data of the wrong shape (different model
+                # width, wrong leaf), not a foreign world's pad;
+                # truncating it would be silent numeric corruption
+                raise ValueError(
+                    f"flat leaf of size {flat.size} carries non-zero "
+                    f"data past the logical {lp.size} elements — not a "
+                    "zero-padded shard layout; refusing to truncate")
+            flat = flat[:lp.size]  # drop a foreign world's zero padding
+        if lp.pad != flat.size:
+            flat = np.concatenate(
+                [flat, np.zeros(lp.pad - flat.size, a.dtype)])
+        return flat
     return jax.tree.map(pack, tree, plan.leaf_plans)
 
 

@@ -63,6 +63,25 @@ class CheckpointCorruptError(ValueError):
     type must keep flowing into those handlers, not crash them."""
 
 
+class WorldSizeMismatchError(ValueError):
+    """A checkpoint was written under a different world
+    ``(replica count, process count, mesh shape)`` than the consumer
+    requires. Deliberately NOT a :class:`CheckpointCorruptError`: a
+    world mismatch affects EVERY step of the run equally, so the
+    restore must not "fall back" past all of them and silently discard
+    the run — it must surface so the caller can branch: the
+    supervisor's reconfigure path (and the mesh-portable
+    ``parallel.api.restore_for_topology``) reshards the artifact for
+    the new world; a strict consumer aborts with both worlds named
+    instead of a raw flax structure error."""
+
+    def __init__(self, msg: str, saved_world: dict | None = None,
+                 requested_world: dict | None = None):
+        super().__init__(msg)
+        self.saved_world = saved_world
+        self.requested_world = requested_world
+
+
 # -- I/O retry wrapper ------------------------------------------------------
 #
 # Checkpoint reads/writes hit network filesystems in production; a
@@ -614,12 +633,77 @@ def read_checkpoint_extra(train_dir: str | Path,
     return extra, step
 
 
-def _restore_sharded(train_dir: Path, template_state: Any,
-                     step: int) -> tuple[Any, dict, int]:
+def read_checkpoint_world(train_dir: str | Path,
+                          step: int | None = None
+                          ) -> tuple[dict | None, int] | None:
+    """The ``world`` record a checkpoint was saved under (the Trainer
+    stamps ``parallel.api.world_signature`` into ``extra``) — what the
+    supervisor's reconfigure path reads to name old vs new world, and
+    None for pre-elastic artifacts. Returns ``(world | None, step)``,
+    or None when nothing is loadable."""
+    got = read_checkpoint_extra(train_dir, step)
+    if got is None:
+        return None
+    extra, step = got
+    world = (extra or {}).get("world")
+    return (world if isinstance(world, dict) else None), step
+
+
+def _check_world(extra: Any, step: int, expect_world: dict | None) -> None:
+    """Strict-world gate: callers that CANNOT reshard (no
+    restore_for_topology in their path) pass the world they require;
+    an artifact recorded under a different world raises the typed
+    mismatch instead of whatever downstream structure error the
+    foreign layout would eventually produce."""
+    if expect_world is None:
+        return
+    saved = (extra or {}).get("world") if isinstance(extra, dict) else None
+    if isinstance(saved, dict) and saved != expect_world:
+        raise WorldSizeMismatchError(
+            f"checkpoint step={step} was saved under world {saved} but "
+            f"this consumer requires world {expect_world}; reshard it "
+            "through parallel.api.restore_for_topology (mesh-portable "
+            "restore) instead of a same-world restore",
+            saved_world=saved, requested_world=expect_world)
+
+
+def _from_state_dict_checked(template_state: Any, saved: Any, extra: Any,
+                             step: int, where: str,
+                             expect_world: dict | None) -> Any:
+    """``from_state_dict`` with the raw structure error upgraded: when
+    the artifact records the world it was saved under, a graft failure
+    names saved vs requested world (the typed error the supervisor's
+    reconfigure path branches on) instead of a bare flax KeyError."""
+    try:
+        return serialization.from_state_dict(template_state, saved)
+    except WorldSizeMismatchError:
+        raise
+    except Exception as e:
+        saved_world = ((extra or {}).get("world")
+                       if isinstance(extra, dict) else None)
+        if isinstance(saved_world, dict) and (
+                expect_world is None or saved_world != expect_world):
+            raise WorldSizeMismatchError(
+                f"{where}: checkpoint step={step} does not fit this "
+                f"run's state template ({type(e).__name__}: {e}); the "
+                f"artifact was saved under world {saved_world}"
+                + (f" but this run is world {expect_world}"
+                   if expect_world is not None else "")
+                + " — reshard it through parallel.api."
+                "restore_for_topology",
+                saved_world=saved_world,
+                requested_world=expect_world) from e
+        raise
+
+
+def _restore_sharded(train_dir: Path, template_state: Any, step: int,
+                     expect_world: dict | None = None
+                     ) -> tuple[Any, dict, int]:
     """Reassemble full global arrays from every process's shard file
     (readable by ANY process count — the evaluator or a resumed
     cluster of a different size reads the same files)."""
     manifest = _read_manifest(train_dir, step)
+    _check_world(manifest.get("extra"), step, expect_world)
     try:
         pcount = int(manifest["num_shards"])
         meta = manifest["leaves"]
@@ -682,7 +766,9 @@ def _restore_sharded(train_dir: Path, template_state: Any,
         raise CheckpointCorruptError(
             f"sharded checkpoint step={step} is missing leaf {e} that "
             "the state requires") from e
-    state = serialization.from_state_dict(template_state, nested)
+    state = _from_state_dict_checked(
+        template_state, nested, manifest.get("extra"), step,
+        _manifest_path(train_dir, step).name, expect_world)
     return state, manifest.get("extra", {}), step
 
 
@@ -701,6 +787,7 @@ _FALLBACK_ERRORS = (FileNotFoundError, CheckpointCorruptError, OSError)
 def restore_checkpoint(train_dir: str | Path, template_state: Any,
                        step: int | None = None,
                        on_event: Callable[[dict], None] | None = None,
+                       expect_world: dict | None = None,
                        ) -> tuple[Any, dict, int] | None:
     """Restore (state, extra, step); None when nothing exists
     (≙ Supervisor's restore-if-present, src/distributed_train.py:262).
@@ -713,10 +800,17 @@ def restore_checkpoint(train_dir: str | Path, template_state: Any,
     back to the next older loadable step instead of wedging the resume
     forever. Each skipped step is reported through ``on_event`` (a
     recovery-journal hook; receives one dict per fallback and one for
-    the step finally restored when any fallback happened)."""
+    the step finally restored when any fallback happened).
+
+    ``expect_world``: a strict same-world gate for consumers that
+    cannot reshard — an artifact recorded under a different world
+    raises :class:`WorldSizeMismatchError` (which, like any template
+    mismatch, is NOT fallen back past: it affects every step equally).
+    Mesh-portable consumers leave it None and restore through
+    ``parallel.api.restore_for_topology``."""
     train_dir = Path(train_dir)
     if step is not None:
-        return _restore_step(train_dir, template_state, step)
+        return _restore_step(train_dir, template_state, step, expect_world)
     candidates = _loadable_steps(train_dir)
     latest = latest_checkpoint_step(train_dir)
     if latest is not None and latest not in candidates:
@@ -724,7 +818,7 @@ def restore_checkpoint(train_dir: str | Path, template_state: Any,
     fell_back = False
     for s in sorted(set(candidates), reverse=True):
         try:
-            got = _restore_step(train_dir, template_state, s)
+            got = _restore_step(train_dir, template_state, s, expect_world)
         except _FALLBACK_ERRORS as e:
             fell_back = True
             logger.warning("checkpoint step=%d is unusable (%s: %s); "
@@ -742,16 +836,21 @@ def restore_checkpoint(train_dir: str | Path, template_state: Any,
     return None
 
 
-def _restore_step(train_dir: Path, template_state: Any,
-                  step: int) -> tuple[Any, dict, int]:
+def _restore_step(train_dir: Path, template_state: Any, step: int,
+                  expect_world: dict | None = None) -> tuple[Any, dict, int]:
     if _manifest_path(train_dir, step).exists():
-        return _restore_sharded(train_dir, template_state, step)
+        return _restore_sharded(train_dir, template_state, step,
+                                expect_world)
     path = _ckpt_path(train_dir, step)
     payload = _msgpack_restore_checked(_verified_read(path), path)
     if not isinstance(payload, dict) or "state" not in payload:
         raise CheckpointCorruptError(
             f"{path.name}: payload has no 'state' entry")
     saved = payload["state"]
+    extra = payload.get("extra", {})
+    if isinstance(extra, (str, bytes)):
+        extra = json.loads(extra)
+    _check_world(extra, step, expect_world)
     # Migration: drop top-level fields the current TrainState no longer
     # has (e.g. pre-round-3 checkpoints carried a measured_ms scalar) —
     # from_state_dict hard-fails on unknown keys, which would make every
@@ -762,8 +861,6 @@ def _restore_step(train_dir: Path, template_state: Any,
         if stale:
             logger.warning("dropping stale checkpoint fields %s", sorted(stale))
             saved = {k: v for k, v in saved.items() if k not in stale}
-    state = serialization.from_state_dict(template_state, saved)
-    extra = payload.get("extra", {})
-    if isinstance(extra, (str, bytes)):
-        extra = json.loads(extra)
+    state = _from_state_dict_checked(template_state, saved, extra, step,
+                                     path.name, expect_world)
     return state, extra, step

@@ -32,8 +32,8 @@ from ..models.registry import Model, get_model
 from ..obsv.timing import StepTimeCollector
 from ..parallel.api import (TrainState, build_eval_step, build_train_step,
                             canonical_save_state, init_train_state,
-                            pack_restored_state, state_partition_specs,
-                            zero1_plan_for)
+                            restore_for_topology, state_partition_specs,
+                            world_signature, zero1_plan_for)
 from . import checkpoint as ckpt
 from .lr_schedule import constant, decay_steps_for, exponential_decay
 
@@ -249,15 +249,17 @@ class Trainer:
             {"event": "recovery", "time": time.time(), **record})
 
     def _maybe_resume(self) -> None:
-        restored = ckpt.restore_checkpoint(self.train_dir, self.state,
-                                           on_event=self._recovery_event)
+        # mesh-portable restore: an artifact saved under ANY world size
+        # reshards onto this run's mesh — the ZeRO-1 plan (padding,
+        # chunk ownership) is re-derived from the CURRENT replica
+        # count, and a world change is journaled as
+        # action:"cross_world_restore" (parallel/api.py)
+        restored = restore_for_topology(self.model, self.cfg, self.topo,
+                                        self.train_dir, self.state,
+                                        on_event=self._recovery_event)
         if restored is None:
             return
         state, extra, step = restored
-        # checkpoints carry the canonical logical optimizer layout —
-        # fold it back into the replica-shard layout the live state
-        # uses (no-op without a plan / without momentum)
-        state = pack_restored_state(state, self._zero1_plan)
         # The gpipe layer-stacked and 1f1b chunk-interleaved layouts
         # have identical tree structure and leaf shapes but DIFFERENT
         # layer order — a shape-matched restore across schedules would
@@ -297,7 +299,11 @@ class Trainer:
         # writes the classic single file alone.
         if not self.is_writer and not ckpt.state_needs_sharded_save(self.state):
             return
-        extra = {"config": self.cfg.to_dict()}
+        # the world the artifact is saved under: what lets a restore
+        # tell "same world" from "resized world, reshard" and the
+        # supervisor name both sides of an elastic reconfigure
+        extra = {"config": self.cfg.to_dict(),
+                 "world": world_signature(self.topo)}
         # through the feed: a prefetching feed reports the cursor of
         # the last CONSUMED batch, not the producer's read-ahead
         # position — a resume must replay batches the step never saw
@@ -337,8 +343,11 @@ class Trainer:
         the NaN and exhaust ``nan_guard_max_rollbacks``."""
         for s in sorted(ckpt.loadable_steps(self.train_dir), reverse=True):
             try:
-                state, extra, got = ckpt.restore_checkpoint(
-                    self.train_dir, self.state, step=s)
+                # the mesh-portable path (rollback candidates may
+                # predate an elastic resize of this very run)
+                state, extra, got = restore_for_topology(
+                    self.model, self.cfg, self.topo, self.train_dir,
+                    self.state, step=s)
             except Exception as e:
                 self._recovery_event({"layer": "train",
                                       "action": "rollback_candidate_unusable",
@@ -349,7 +358,6 @@ class Trainer:
                                       "action": "rollback_candidate_poisoned",
                                       "step": s})
                 continue
-            state = pack_restored_state(state, self._zero1_plan)
             self.state = self.topo.device_put_state(state, self.state_specs)
             if "data_iter" in extra:
                 try:
@@ -451,9 +459,9 @@ class Trainer:
             from ..parallel.aot import aot_cache_key
             cache_key = aot_cache_key(self.model, self.cfg, self.topo)
         before = cache_stats(cache_dir) if cache_dir is not None else None
-        info = self.step_fn.precompile(self.state, gbatch,
-                                       cache_dir=cache_dir,
-                                       cache_key=cache_key)
+        info = self.step_fn.precompile(
+            self.state, gbatch, cache_dir=cache_dir, cache_key=cache_key,
+            trust_cross_process=self.cfg.compile.trust_cache_cross_process)
         if before is not None:
             after = cache_stats(cache_dir)
             # zero new entries across a compile = every program came

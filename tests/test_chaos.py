@@ -227,7 +227,8 @@ def test_drain_closes_open_mttr_episode(tmp_path):
     camp._drain(_StubDrainCluster(logdir, spawned_at=time.time() + 3600,
                                   alive=False), sup)
     assert sup.open_episodes == {0}
-    assert sup.summary()["mttr"] == {"episodes": 0, "unrecovered": 1}
+    assert sup.summary()["mttr"] == {"episodes": 0, "unrecovered": 1,
+                                     "superseded": 0}
 
     # (c) log moved since spawn but the newest record is the restarted
     # trainer's compile event (it wedged before its first step): a

@@ -51,7 +51,11 @@ def test_enable_persistent_cache_sets_jax_config_and_stats(tmp_path):
     d = tmp_path / "cache"
     prev = jax.config.jax_compilation_cache_dir
     try:
-        got = cc.enable_persistent_cache(CompileConfig(cache_dir=str(d)))
+        # this container's jax is inside the cross-process corruption
+        # quarantine — the wiring is exercised through the validated-
+        # platform override (the quarantine itself is pinned below)
+        got = cc.enable_persistent_cache(
+            CompileConfig(cache_dir=str(d), trust_cache_cross_process=True))
         assert got == d and d.is_dir()
         assert jax.config.jax_compilation_cache_dir == str(d)
         import jax.numpy as jnp
@@ -75,6 +79,45 @@ def test_enable_persistent_cache_sets_jax_config_and_stats(tmp_path):
         from jax._src import compilation_cache as _ccache
         _ccache.reset_cache()
         cc._enabled_dir = None
+
+
+@pytest.mark.skipif(cc.cross_process_reuse_quarantined() is None,
+                    reason="this jax is outside the corruption quarantine")
+def test_cache_quarantine_on_known_bad_jax(tmp_path):
+    """jax <= 0.4.37 deserializes corrupt executables cross-process
+    (wrong numerics then SIGSEGV on restarted workers — measured 13/13
+    on this container): by DEFAULT both cache layers refuse, and only
+    the explicit validated-platform override re-enables them."""
+    d = tmp_path / "q"
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        assert cc.enable_persistent_cache(
+            CompileConfig(cache_dir=str(d))) is None
+        assert jax.config.jax_compilation_cache_dir == prev
+        assert not d.exists()  # refused before any side effect
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+    # the AOT disk cache refuses BOTH directions untrusted...
+    fn, args = _jit_and_args()
+    _, info = aot.aot_compile(fn, args, cache_dir=tmp_path, key="kq")
+    assert info["source"] == "compiled" and info["serialized"] is False
+    assert not (tmp_path / "aot" / "kq.exe").exists()
+    # ...and a pre-existing foreign entry is never loaded untrusted
+    _, info_t = aot.aot_compile(fn, args, cache_dir=tmp_path, key="kq",
+                                trust_cross_process=True)
+    if info_t["serialized"]:  # platform can serialize: plant foreign pid
+        import os
+        import pickle
+        entry = tmp_path / "aot" / "kq.exe"
+        pid, *rest = pickle.loads(entry.read_bytes())
+        entry.write_bytes(pickle.dumps((pid + 1, *rest)))
+        fn2, _ = _jit_and_args()
+        _, info2 = aot.aot_compile(fn2, args, cache_dir=tmp_path, key="kq")
+        assert info2["source"] == "compiled"  # quarantined: not aot_disk
+    # config surface: the override round-trips
+    cfg = ExperimentConfig.from_dict(
+        {"compile": {"trust_cache_cross_process": True}})
+    assert cfg.compile.trust_cache_cross_process is True
 
 
 # ---------------------------------------------------------------------------
@@ -163,8 +206,13 @@ def _jit_and_args():
 
 
 def test_aot_disk_cache_roundtrip_and_corruption(tmp_path):
+    # trust override: the roundtrip mechanics under test are what the
+    # quarantine (tested above) would otherwise short-circuit
+    def compile_trusted(fn, args, **kw):
+        return aot.aot_compile(fn, args, trust_cross_process=True, **kw)
+
     fn, args = _jit_and_args()
-    compiled, info = aot.aot_compile(fn, args, cache_dir=tmp_path, key="k1")
+    compiled, info = compile_trusted(fn, args, cache_dir=tmp_path, key="k1")
     assert info["source"] == "compiled"
     assert float(compiled(*args)) == float(fn(*args))
     if not info["serialized"]:
@@ -174,7 +222,7 @@ def test_aot_disk_cache_roundtrip_and_corruption(tmp_path):
     # same-process deserialize of a real train step corrupts the
     # runtime) — the load quietly falls back to a compile
     fn2, _ = _jit_and_args()
-    _, info_same = aot.aot_compile(fn2, args, cache_dir=tmp_path, key="k1")
+    _, info_same = compile_trusted(fn2, args, cache_dir=tmp_path, key="k1")
     assert info_same["source"] == "compiled"
     # a FOREIGN process's entry (different stored pid) is served from
     # disk with a bitwise-identical result — the restart fast path
@@ -184,12 +232,12 @@ def test_aot_disk_cache_roundtrip_and_corruption(tmp_path):
     pid, *rest = pickle.loads(entry.read_bytes())
     assert pid == os.getpid()
     entry.write_bytes(pickle.dumps((pid + 1, *rest)))
-    compiled2, info2 = aot.aot_compile(fn2, args, cache_dir=tmp_path,
+    compiled2, info2 = compile_trusted(fn2, args, cache_dir=tmp_path,
                                        key="k1")
     assert info2["source"] == "aot_disk"
     assert float(compiled2(*args)) == float(compiled(*args))
     # a DIFFERENT key is a miss, never a stale reuse
-    _, info3 = aot.aot_compile(fn2, args, cache_dir=tmp_path, key="k-other")
+    _, info3 = compile_trusted(fn2, args, cache_dir=tmp_path, key="k-other")
     assert info3["source"] == "compiled"
     # corrupt the entry: logged fallback to cold compile, entry healed
     # (deleted), never a crash
@@ -201,7 +249,7 @@ def test_aot_disk_cache_roundtrip_and_corruption(tmp_path):
     handler.emit = lambda rec: msgs.append(rec.getMessage())
     logging.getLogger("distributedmnist_tpu.aot").addHandler(handler)
     try:
-        compiled4, info4 = aot.aot_compile(fn2, args, cache_dir=tmp_path,
+        compiled4, info4 = compile_trusted(fn2, args, cache_dir=tmp_path,
                                            key="k1")
     finally:
         logging.getLogger("distributedmnist_tpu.aot").removeHandler(handler)
@@ -218,13 +266,14 @@ def test_aot_unsupported_platform_marker_short_circuits(tmp_path):
     the cache dir unsupported; later processes skip the probe and go
     straight to the compile (persistent-cache-warm) path."""
     fn, args = _jit_and_args()
-    cache = aot.ExecutableCache(tmp_path)
+    cache = aot.ExecutableCache(tmp_path, trust_cross_process=True)
     assert not cache.serialization_known_unsupported()
     cache._mark_unsupported(RuntimeError("Symbols not found"))
     assert cache.serialization_known_unsupported()
     # load AND store now short-circuit without touching the backend
     assert cache.load("k1") is None
-    compiled, info = aot.aot_compile(fn, args, cache_dir=tmp_path, key="k1")
+    compiled, info = aot.aot_compile(fn, args, cache_dir=tmp_path, key="k1",
+                                     trust_cross_process=True)
     assert info["source"] == "compiled" and info["serialized"] is False
     assert not (tmp_path / "aot" / "k1.exe").exists()
     assert float(compiled(*args)) == float(fn(*args))

@@ -63,7 +63,9 @@ def test_state_is_last_consumed_not_produced():
         assert time.monotonic() < deadline, "producer never filled the queue"
         threading.Event().wait(0.01)
     st = pf.state()
-    assert st == {"impl": "numpy", "epoch": 0, "pos": 24}
+    # core cursor fields (the state also carries the world/batches
+    # coordinates the elastic cross-world reassignment reads)
+    assert (st["impl"], st["epoch"], st["pos"]) == ("numpy", 0, 24)
     assert it.state()["pos"] > st["pos"]  # producer genuinely read ahead
 
     fresh = BatchIterator(_dataset(96), batch_size=8, seed=1)
@@ -103,8 +105,8 @@ def test_consumer_exception_clean_shutdown():
     except RuntimeError:
         pf.stop()
     assert pf._thread is None or not pf._thread.is_alive()
-    assert it.state() == pf.state() == {"impl": "numpy", "epoch": 0,
-                                        "pos": 16}
+    assert it.state() == pf.state()
+    assert (pf.state()["epoch"], pf.state()["pos"]) == (0, 16)
     # stop() is resumable: the stream continues with batch 3
     ref = BatchIterator(_dataset(), batch_size=8, seed=5)
     ref.restore({"impl": "numpy", "epoch": 0, "pos": 16})

@@ -1,0 +1,630 @@
+"""Elastic world-size reconfiguration (ROADMAP item 2): mesh-portable
+checkpoints, the supervisor's shrink/grow verb, the chaos resize fault,
+and the cross-world resume invariant.
+
+Three layers under test:
+
+* **Checkpoint portability** — a ZeRO-1 artifact saved under one
+  replica count restores bitwise onto another (the Zero1Plan is
+  re-derived from the NEW world; padding/chunk ownership re-computed),
+  the data cursor reassigns across host counts with no sample range
+  dropped or double-visited, and a strict same-world consumer gets the
+  typed ``WorldSizeMismatchError`` instead of a raw structure error.
+* **Supervisor elasticity** — below-quorum with budgets exhausted
+  SHRINKS the world to the survivors (quorum rescaled, journaled as
+  ``event: "reconfigure"``); an explicit grow seeds a fresh worker
+  from a survivor's checkpoint and promotes a warm standby into it.
+* **Chaos + invariants** — resize is the sixth seeded fault kind, the
+  report counts scheduled-vs-fired faults, and a run whose world
+  changed without the journaled license fails replay.
+"""
+
+import json
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import base_config
+from distributedmnist_tpu.data.datasets import make_synthetic
+from distributedmnist_tpu.data.pipeline import (BatchIterator,
+                                                consumed_sample_ranges)
+from distributedmnist_tpu.launch.chaos import (ChaosCampaign, ChaosConfig,
+                                               ChaosFault, ChaosSchedule,
+                                               count_fired_faults,
+                                               generate_schedule)
+from distributedmnist_tpu.launch.cluster import (LocalClusterConfig,
+                                                 LocalProcessCluster)
+from distributedmnist_tpu.launch.exec import (CommandExecutor, FaultPlan,
+                                              RetryPolicy)
+from distributedmnist_tpu.launch.supervisor import (ClusterSupervisor,
+                                                    SupervisorConfig)
+from distributedmnist_tpu.obsv.invariants import check_run
+from distributedmnist_tpu.obsv.journal import (load_reconfigure_events,
+                                               summarize_chaos)
+from distributedmnist_tpu.parallel.api import canonical_save_state
+from distributedmnist_tpu.train import checkpoint as ckpt
+from distributedmnist_tpu.train.loop import Trainer
+
+pytestmark = pytest.mark.tier1
+
+
+# ---------------------------------------------------------------------------
+# mesh-portable checkpoints
+# ---------------------------------------------------------------------------
+
+def _world_cfg(n_replicas: int, train_dir: str):
+    return base_config(
+        optim={"momentum": 0.9},
+        parallel={"shard_weight_update": True},
+        mesh={"num_replicas": n_replicas},
+        train={"max_steps": 4, "log_every_steps": 2,
+               "save_interval_steps": 2, "save_results_period": 0,
+               "train_dir": train_dir, "async_checkpoint": False})
+
+
+def test_zero1_checkpoint_restores_across_world_sizes(tmp_path,
+                                                      synthetic_datasets):
+    """Save at n=8 → restore at n=2 and n=1: params BITWISE equal, the
+    re-derived Zero1Plan owns correctly re-padded chunks (momentum
+    unpacks to the canonical buffers exactly), and the cross-world
+    restore is journaled. Then the grow direction: a n=2 artifact
+    restores onto the full 8-replica mesh."""
+    d8 = str(tmp_path / "w8")
+    t8 = Trainer(_world_cfg(8, d8), datasets=synthetic_datasets)
+    assert t8._zero1_plan is not None and t8._zero1_plan.n == 8
+    t8.run()
+    digest = ckpt.state_params_digest(t8.state)
+    canonical = canonical_save_state(t8.state, t8._zero1_plan).momentum
+    world, step = ckpt.read_checkpoint_world(d8)
+    assert step == 4 and world["num_replicas"] == 8
+
+    for n_new in (2, 1):
+        t = Trainer(_world_cfg(n_new, d8), datasets=synthetic_datasets)
+        assert int(jax.device_get(t.state.step)) == 4
+        # bitwise params across the world change
+        assert ckpt.state_params_digest(t.state) == digest
+        # chunk ownership: the live momentum (re-packed for n_new)
+        # unpacks to the SAME canonical buffers the n=8 run saved —
+        # wrong padding or chunk assignment would scramble this
+        got = canonical_save_state(t.state, t._zero1_plan).momentum
+        for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(canonical)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        if n_new > 1:
+            assert t._zero1_plan is not None and t._zero1_plan.n == n_new
+            for leaf, lp in zip(
+                    jax.tree.leaves(t.state.momentum),
+                    jax.tree.leaves(t._zero1_plan.leaf_plans,
+                                    is_leaf=lambda x: hasattr(x, "sharded"))):
+                if lp.sharded:
+                    assert leaf.shape == (lp.chunk * n_new,)
+        else:
+            assert t._zero1_plan is None  # n=1: nothing to shard
+        # the world change left journaled evidence
+        events = [json.loads(l)
+                  for l in open(tmp_path / "w8" / "recovery_journal.jsonl")]
+        assert any(e.get("action") == "cross_world_restore"
+                   and e["saved_world"]["num_replicas"] == 8
+                   and e["new_world"]["num_replicas"] == n_new
+                   for e in events)
+
+    # grow: 2 → 8
+    d2 = str(tmp_path / "w2")
+    t2 = Trainer(_world_cfg(2, d2), datasets=synthetic_datasets)
+    t2.run()
+    dig2 = ckpt.state_params_digest(t2.state)
+    canon2 = canonical_save_state(t2.state, t2._zero1_plan).momentum
+    t8b = Trainer(_world_cfg(8, d2), datasets=synthetic_datasets)
+    assert int(jax.device_get(t8b.state.step)) == 4
+    assert ckpt.state_params_digest(t8b.state) == dig2
+    got = canonical_save_state(t8b.state, t8b._zero1_plan).momentum
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(canon2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_zero1_pack_repacks_foreign_world_flat_layout():
+    """Unit view of the portability fix: a leaf flat-packed under
+    n_old re-packs exactly under n_new (padding is zeros by contract),
+    and a genuinely mismatched leaf still raises."""
+    from jax.sharding import PartitionSpec as P
+    from distributedmnist_tpu.parallel.partition_rules import (
+        make_zero1_plan, zero1_pack, zero1_unpack)
+    params = {"w": np.arange(10, dtype=np.float32).reshape(2, 5)}
+    specs = {"w": P()}
+    p8 = make_zero1_plan(params, specs, "replica", 8)
+    p2 = make_zero1_plan(params, specs, "replica", 2)
+    flat8 = zero1_pack(params, p8)["w"]
+    assert flat8.shape == (16,)  # ceil(10/8)*8
+    repacked = zero1_pack({"w": flat8}, p2)["w"]
+    np.testing.assert_array_equal(repacked, zero1_pack(params, p2)["w"])
+    np.testing.assert_array_equal(zero1_unpack({"w": repacked}, p2)["w"],
+                                  params["w"])
+    with pytest.raises(ValueError, match="cannot pack"):
+        zero1_pack({"w": np.arange(4, dtype=np.float32)}, p2)
+    # an oversized 1-D leaf whose tail is REAL DATA (not zero padding)
+    # must refuse loudly — truncating it would be silent corruption
+    with pytest.raises(ValueError, match="refusing to truncate"):
+        zero1_pack({"w": np.arange(1, 17, dtype=np.float32)}, p2)
+
+
+def test_data_cursor_reassignment_property():
+    """The no-drop/no-double-visit contract: after reassigning cursors
+    from a 4-host world into a 2-host world, the union of consumed
+    sample-slot ranges is unchanged and per-host ranges stay
+    disjoint."""
+    ds = make_synthetic(num_train=260, num_test=16).train
+    B = 24
+    olds = [BatchIterator(ds, B, seed=3, host_id=h, num_hosts=4)
+            for h in range(4)]
+    for _ in range(55):           # lockstep: one global batch per tick
+        for it in olds:
+            next(it)
+    states = [it.state() for it in olds]
+    assert all(s["batches"] == 55 for s in states)
+
+    def union(ranges):
+        r = sorted(ranges)
+        assert all(a[1] <= b[0] for a, b in zip(r, r[1:])), "overlap"
+        assert all(a[1] == b[0] for a, b in zip(r, r[1:])), "gap"
+        return (r[0][0], r[-1][1])
+
+    old_union = union(x for s in states for x in consumed_sample_ranges(s))
+    assert old_union == (0, 55 * B)
+
+    news = [BatchIterator(ds, B, seed=3, host_id=h, num_hosts=2)
+            for h in range(2)]
+    for it in news:
+        # any old host's state carries the same lockstep coordinate
+        it.restore(states[it.host_id])
+    new_states = [it.state() for it in news]
+    assert union(x for s in new_states
+                 for x in consumed_sample_ranges(s)) == old_union
+    # the new-world cursor is a genuine stream position: epoch/pos
+    # re-derived from the NEW shard's batches-per-epoch
+    for it in news:
+        assert it.batches_consumed == 55
+    # same-world restore is byte-exact (legacy behavior preserved)
+    again = BatchIterator(ds, B, seed=3, host_id=1, num_hosts=4)
+    again.restore(states[1])
+    assert again.state() == states[1]
+
+
+def test_world_size_mismatch_error_is_typed(tmp_path):
+    """A strict same-world consumer gets WorldSizeMismatchError naming
+    saved vs requested world — branchable, unlike the raw structure
+    error it used to surface as."""
+    from distributedmnist_tpu.train.checkpoint import (
+        WorldSizeMismatchError, restore_checkpoint, save_checkpoint)
+    state = {"params": {"w": np.arange(8, dtype=np.float32)}}
+    saved_world = {"num_replicas": 8, "process_count": 1,
+                   "mesh": {"replica": 8}}
+    save_checkpoint(tmp_path, state, step=3,
+                    extra={"world": saved_world})
+    want = {"num_replicas": 2, "process_count": 1, "mesh": {"replica": 2}}
+    with pytest.raises(WorldSizeMismatchError) as ei:
+        restore_checkpoint(tmp_path, state, expect_world=want)
+    assert ei.value.saved_world == saved_world
+    assert ei.value.requested_world == want
+    assert "restore_for_topology" in str(ei.value)
+    # matching world restores fine through the same gate
+    got = restore_checkpoint(tmp_path, state, expect_world=saved_world)
+    assert got is not None and got[2] == 3
+    # and the typed error must NOT be swallowed by the corruption
+    # fallback (it is not a CheckpointCorruptError)
+    from distributedmnist_tpu.train.checkpoint import CheckpointCorruptError
+    assert not issubclass(WorldSizeMismatchError, CheckpointCorruptError)
+
+
+# ---------------------------------------------------------------------------
+# supervisor shrink/grow (shell payload — real worker processes)
+# ---------------------------------------------------------------------------
+
+_RESUMING_LOOP = ('i=$( [ -f ckpt ] && cat ckpt || echo 0 ); '
+                  'echo $i >> boots.txt; '
+                  'while [ $i -lt 400 ]; do i=$((i+1)); '
+                  'echo "{\\"step\\": $i, \\"loss\\": 1.0}" '
+                  '>> train_log.jsonl; '
+                  'if [ $((i % 5)) -eq 0 ]; then echo $i > ckpt; fi; '
+                  'sleep 0.05; done')
+
+_STANDBY_LOOP = (
+    'touch "$DMT_STANDBY_ACTIVATION.ready"; '
+    'while [ ! -f "$DMT_STANDBY_ACTIVATION" ]; do sleep 0.05; done; '
+    'cd "$(python3 -c "import json,os;'
+    "print(json.load(open(os.environ['DMT_STANDBY_ACTIVATION']))"
+    "['train_dir'])" '")" && ' + _RESUMING_LOOP)
+
+
+def _cluster(tmp_path, fault_plan=None, num_workers=2, standby_command=""):
+    cfg = LocalClusterConfig(name="el", workdir=str(tmp_path / "cl"),
+                             num_workers=num_workers,
+                             train_command=_RESUMING_LOOP,
+                             standby_command=standby_command)
+    ex = CommandExecutor(journal=cfg.root / "command_journal.jsonl",
+                         retry=RetryPolicy(max_attempts=1),
+                         fault_plan=fault_plan)
+    return LocalProcessCluster(cfg, ex)
+
+
+def test_elastic_shrink_below_quorum_reconfigures_and_finishes(tmp_path):
+    """The satellite + tentpole in one: worker 2 dies past its (zero)
+    restart budget with quorum == num_workers; an elastic supervisor
+    drains the survivors, reshapes 3→2, RESCALES quorum (3 would abort
+    the resized world instantly), relaunches, and the run reaches the
+    target resuming from the last checkpoints — all journaled as
+    event:"reconfigure" with the drain→first-moved-step latency."""
+    c = _cluster(tmp_path, num_workers=3,
+                 fault_plan=FaultPlan(kill_worker_at_step={2: 7}))
+    c.create()
+    sup = ClusterSupervisor(c, SupervisorConfig(
+        quorum=3, max_restarts_per_worker=0, elastic=True, min_workers=2,
+        reconfigure_drain_s=5.0))
+    got = sup.run_until_step(40, poll_secs=0.2, timeout_secs=120.0)
+    assert got["step"] >= 40
+    rs = got["recovery"]["reconfigure"]
+    assert rs["count"] == 1
+    tr = rs["transitions"][0]
+    assert (tr["old_world"], tr["new_world"]) == (3, 2)
+    assert tr["trigger"] == "below_quorum"
+    assert tr["quorum"] == 3 and tr["effective_quorum"] == 2
+    assert tr["reconfigure_s"] > 0  # drain→first-moved-step closed
+    # journaled causal license, artifact-side
+    recs = load_reconfigure_events(c.exec.journal_path)
+    assert [r["action"] for r in recs] == ["begin", "reshape",
+                                           "relaunched", "resume"]
+    # roster shrank to the survivors, ids and logdirs preserved
+    state = json.loads(c.state_path.read_text())
+    assert [w["worker"] for w in state["workers"]] == [0, 1]
+    # survivors RESUMED from their checkpoints, not step 0
+    for k in (0, 1):
+        boots = [int(x) for x in
+                 (c.cfg.worker_dir(k) / "boots.txt").read_text().split()]
+        assert len(boots) == 2 and boots[1] > 0 and boots[1] % 5 == 0, boots
+    c.delete()
+
+
+def test_non_elastic_below_quorum_still_aborts(tmp_path):
+    """elastic=False keeps the established bounded-degradation
+    contract: below quorum with nothing restartable aborts."""
+    from distributedmnist_tpu.launch.cluster import ClusterError
+    c = _cluster(tmp_path, num_workers=2,
+                 fault_plan=FaultPlan(kill_worker_at_step={1: 2}))
+    c.create()
+    sup = ClusterSupervisor(c, SupervisorConfig(
+        quorum=2, max_restarts_per_worker=0))
+    with pytest.raises(ClusterError, match="< quorum 2"):
+        sup.run_until_step(50, poll_secs=0.2, timeout_secs=120.0)
+    assert not load_reconfigure_events(c.exec.journal_path)
+    c.delete()
+
+
+def test_reconfigure_grow_promotes_standby_and_seeds_checkpoint(tmp_path):
+    """The grow path, supervisor-level: an explicit reconfigure 2→3
+    seeds the new worker's logdir from a survivor's checkpoint and
+    promotes the parked warm standby into it (via: standby); the
+    larger world reaches the target step."""
+    c = _cluster(tmp_path, standby_command=_STANDBY_LOOP)
+    c.create()
+    sup = ClusterSupervisor(c, SupervisorConfig(quorum=1,
+                                                standby_workers=1))
+    c.run_train()
+    c.ensure_standbys(1)
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        prog = c.worker_progress()
+        st = c.status()
+        if (prog and min(prog.values()) >= 6
+                and any(s["ready"] for s in st.get("standbys", []))):
+            break
+        time.sleep(0.2)
+    rec = sup.reconfigure(3, trigger="manual")
+    assert rec["old_world"] == 2 and rec["new_world"] == 3
+    assert rec["grown"] == {"2": 0}
+    try:
+        got = sup.supervise_until_step(40, poll_secs=0.2,
+                                       timeout_secs=120.0)
+    finally:
+        c.kill_all()
+    assert got["step"] >= 40
+    tr = got["recovery"]["reconfigure"]["transitions"][0]
+    assert tr["via"]["2"] == "standby"  # warm grow, not a cold spawn
+    assert tr["reconfigure_s"] > 0
+    # the grown worker resumed from the SEEDED checkpoint, not step 0
+    boots = [int(x) for x in
+             (c.cfg.worker_dir(2) / "boots.txt").read_text().split()]
+    assert boots[0] > 0 and boots[0] % 5 == 0, boots
+    state = json.loads(c.state_path.read_text())
+    assert [w["worker"] for w in state["workers"]] == [0, 1, 2]
+    c.delete()
+
+
+def test_wait_drained_covers_whole_process_group(tmp_path):
+    """The drain must wait for the process GROUP, not the recorded sh
+    leader: dash FORKS the payload, so on a group SIGTERM the leader
+    dies instantly while the python trainer behind it is still
+    flushing its preemption checkpoint — a leader-pid wait would
+    SIGKILL that flush mid-write (measured: the resumed run lost its
+    preemption checkpoint and rewound a full save interval)."""
+    slow_flush = (
+        "python3 -c \""
+        "import signal, sys, time\n"
+        "def h(*a):\n"
+        "    time.sleep(1.5)\n"  # the flush window a leader-wait loses
+        "    open('flushed', 'w').write('1')\n"
+        "    sys.exit(75)\n"
+        "signal.signal(signal.SIGTERM, h)\n"
+        "open('ready', 'w').write('1')\n"
+        "[time.sleep(0.1) for _ in range(600)]\"")
+    cfg = LocalClusterConfig(name="dr", workdir=str(tmp_path / "cl"),
+                             num_workers=1, train_command=slow_flush)
+    ex = CommandExecutor(journal=cfg.root / "command_journal.jsonl",
+                         retry=RetryPolicy(max_attempts=1))
+    c = LocalProcessCluster(cfg, ex)
+    c.create()
+    c.run_train()
+    flag = c.cfg.worker_dir(0) / "flushed"
+    deadline = time.monotonic() + 10.0
+    # wait until the payload proves its handler is installed
+    while (not (c.cfg.worker_dir(0) / "ready").exists()
+           and time.monotonic() < deadline):
+        time.sleep(0.1)
+    t0 = time.monotonic()
+    c.stop_all()
+    assert c.wait_drained(10.0, poll_secs=0.2)
+    took = time.monotonic() - t0
+    # the group-wait outlived the leader's instant death and covered
+    # the whole 1.5 s flush — and the flush actually landed
+    assert flag.exists(), "drain SIGKILLed the flush"
+    assert took >= 1.0, f"drain returned in {took:.2f}s — leader-only wait"
+    c.delete()
+
+
+def test_quorum_rescale_clamps_into_new_world():
+    cfg = SupervisorConfig(quorum=3)
+    assert cfg.rescaled_quorum(2) == 2
+    assert cfg.rescaled_quorum(5) == 3
+    assert cfg.rescaled_quorum(1) == 1
+    assert SupervisorConfig(quorum=1).rescaled_quorum(4) == 1
+
+
+def test_can_reconfigure_requires_backend_override():
+    """The base class DEFINES reconfigure (raising), so a hasattr probe
+    would drain a gcloud cluster and then crash mid-reshape; the
+    capability check demands an actual override."""
+    from distributedmnist_tpu.launch.cluster import GcloudTpuBackend
+    sup = ClusterSupervisor.__new__(ClusterSupervisor)
+    sup.backend = GcloudTpuBackend.__new__(GcloudTpuBackend)
+    assert not sup._can_reconfigure()
+    sup.backend = LocalProcessCluster.__new__(LocalProcessCluster)
+    assert sup._can_reconfigure()
+    sup.backend = object()  # scripted test backends: no verb at all
+    assert not sup._can_reconfigure()
+
+
+# ---------------------------------------------------------------------------
+# chaos: the sixth fault kind + scheduled-vs-fired accounting
+# ---------------------------------------------------------------------------
+
+def test_generate_schedule_resize_kind_and_legacy_stability():
+    """The resize draw rides AFTER every legacy draw: resize-less
+    configs reproduce their historical schedules byte-identically, and
+    with candidates armed exactly one cluster-level resize appears."""
+    base = generate_schedule(7, 3, 2, (6, 20), max_faults=3)
+    with_rz = generate_schedule(7, 3, 2, (6, 20), max_faults=3,
+                                resize_worlds=(1, 3), resize_prob=1.0)
+    assert tuple(f for f in with_rz.faults
+                 if f.kind != "resize") == base.faults
+    rz = [f for f in with_rz.faults if f.kind == "resize"]
+    assert len(rz) == 1
+    assert rz[0].world in (1, 3) and 6 <= rz[0].step <= 20
+    assert "resize(→" in with_rz.describe()
+    # FaultPlan mapping + file-format roundtrip (the reproducer seam)
+    plan = with_rz.to_fault_plan()
+    assert plan.resize_world_at_step == (rz[0].step, rz[0].world)
+    assert FaultPlan.from_json_dict(plan.to_json_dict()) == plan
+
+
+def test_chaos_resize_trial_shrinks_world_and_invariants_pass(tmp_path):
+    """A seeded trial with the resize fault armed: the supervised run
+    reshapes mid-run, completes on the smaller world, and every
+    applicable invariant — including the new cross-world resume
+    invariant — passes; the report records scheduled vs fired."""
+    cfg = ChaosConfig(name="rz", trials=1, seed=0, until_step=30,
+                      payload="shell", workdir=str(tmp_path),
+                      resize_prob=1.0, resize_worlds=(1,), shrink=False,
+                      trial_timeout_s=90.0, drain_timeout_s=30.0)
+    summary = ChaosCampaign(cfg).run()
+    assert summary["all_green"] is True, summary
+    assert summary["invariants"]["reconfigure"]["pass"] == 1
+    assert summary["reconfigures"] == 1
+    assert summary["faults"]["scheduled"] >= 1
+    assert 1 <= summary["faults"]["fired"] <= summary["faults"]["scheduled"]
+    # the resize itself FIRED; faults still scheduled on the dropped
+    # worker after the shrink legitimately land in `unfired` — the
+    # accounting this PR adds is what makes that visible
+    per = summary["faults"]["per_trial"][0]
+    assert not any(f["kind"] == "resize" for f in per["unfired"])
+    assert all(f.get("worker") == 1 for f in per["unfired"]), per
+    rec = [json.loads(l) for l in
+           open(tmp_path / "rz" / "chaos_report.jsonl")][0]
+    assert any(f["kind"] == "resize"
+               for f in rec["schedule"]["faults"])
+    assert rec["final_world"] == 1
+    # a second summarize pass over the artifact reproduces the verdict
+    again = summarize_chaos(tmp_path / "rz" / "chaos_report.jsonl")
+    assert again["all_green"] and again["faults"] == summary["faults"]
+
+
+def test_chaos_report_counts_scheduled_but_never_fired_faults(tmp_path):
+    """PR 7's blind spot closed: a kill scheduled past run-end fires
+    nothing — the report must say so instead of looking identical to a
+    real all-quiet run."""
+    trial = tmp_path / "t"
+    trial.mkdir()
+    (trial / "command_journal.jsonl").write_text(json.dumps(
+        {"event": "fault", "action": "kill_worker", "worker": 0,
+         "at_step": 9, "planned_step": 8}) + "\n")
+    sched = ChaosSchedule(seed=1, trial=0, faults=(
+        ChaosFault("kill", worker=0, step=8),
+        ChaosFault("kill", worker=1, step=1000),   # never fires
+        ChaosFault("resize", step=2000, world=1),  # never fires
+    ))
+    got = count_fired_faults(trial, sched)
+    assert got["scheduled"] == 3 and got["fired"] == 1
+    assert {f["kind"] for f in got["unfired"]} == {"kill", "resize"}
+    # ...and the campaign aggregate surfaces it
+    (trial / "chaos_report.jsonl").write_text(json.dumps(
+        {"event": "chaos_trial", "trial": 0, "seed": 1,
+         "outcome": "completed", "verdicts": {}, "violations": [],
+         "faults": got, "reconfigures": 0}) + "\n")
+    s = summarize_chaos(trial / "chaos_report.jsonl")
+    assert s["faults"] == {"scheduled": 3, "fired": 1, "never_fired": 2,
+                           "per_trial": [{"trial": 0, "scheduled": 3,
+                                          "fired": 1,
+                                          "unfired": got["unfired"]}]}
+
+
+# ---------------------------------------------------------------------------
+# the cross-world resume invariant, artifact-only
+# ---------------------------------------------------------------------------
+
+def _write_trial(trial, steps=10, workers=(0,), journal_lines=()):
+    trial.mkdir(parents=True, exist_ok=True)
+    for k in workers:
+        d = trial / f"worker{k}"
+        d.mkdir(exist_ok=True)
+        with open(d / "train_log.jsonl", "w") as fh:
+            for s in range(1, steps + 1):
+                fh.write(json.dumps({"step": s, "loss": 1.0}) + "\n")
+    with open(trial / "command_journal.jsonl", "w") as fh:
+        for rec in journal_lines:
+            fh.write(json.dumps(rec) + "\n")
+    (trial / "state.json").write_text(json.dumps(
+        {"phase": "running",
+         "workers": [{"worker": k, "pid": None,
+                      "logdir": str(trial / f"worker{k}")}
+                     for k in workers]}))
+
+
+def test_reconfigure_invariant_requires_causal_license(tmp_path):
+    """A run whose final roster differs from its launch world with NO
+    journaled reconfigure event fails replay; adding the journaled
+    reshape (the license) turns the same artifacts green."""
+    outcome = {"outcome": "completed", "step": 10, "target": 10,
+               "num_workers": 2, "final_world": 1,
+               "supervisor": {"quorum": 1, "max_restarts_per_worker": 2}}
+    trial = tmp_path / "silent"
+    _write_trial(trial, workers=(0,))
+    got = check_run(trial, outcome=outcome)
+    assert got["verdicts"]["reconfigure"] == "fail"
+    assert any("no causal license" in v["detail"]
+               for v in got["violations"])
+
+    licensed = tmp_path / "licensed"
+    _write_trial(licensed, workers=(0,), journal_lines=[
+        {"event": "reconfigure", "layer": "supervisor", "action": "begin",
+         "old_world": 2, "new_world": 1, "trigger": "below_quorum"},
+        {"event": "reconfigure", "layer": "cluster", "action": "reshape",
+         "old_world": 2, "new_world": 1, "workers": [0], "dropped": [1],
+         "grown": {}},
+        {"event": "reconfigure", "layer": "supervisor",
+         "action": "relaunched", "old_world": 2, "new_world": 1,
+         "workers": [0], "via": {"0": "respawn"}},
+    ])
+    got = check_run(licensed, outcome=outcome)
+    assert got["verdicts"]["reconfigure"] == "pass", got["violations"]
+
+    # a journal that lies about the final roster fails too
+    lying = tmp_path / "lying"
+    _write_trial(lying, workers=(0,), journal_lines=[
+        {"event": "reconfigure", "layer": "cluster", "action": "reshape",
+         "old_world": 2, "new_world": 1, "workers": [0, 1], "grown": {}},
+    ])
+    got = check_run(lying, outcome=outcome)
+    assert got["verdicts"]["reconfigure"] == "fail"
+    assert any("disagree" in v["detail"] for v in got["violations"])
+
+
+def test_reconfigure_supersedes_open_episode_not_unrecovered():
+    """A kill opens a recovery episode; a reconfigure fires while the
+    worker is still booting. The reshape replaces the in-flight
+    restart, so no per-worker resume ever closes the episode — it must
+    be filed as SUPERSEDED, not left distorting `unrecovered` on a
+    fully recovered run."""
+    from distributedmnist_tpu.obsv.journal import summarize_mttr
+    got = summarize_mttr([
+        {"action": "detect", "worker": 1, "time": 10.0},
+        {"action": "episode_superseded", "worker": 1,
+         "by": "reconfigure", "time": 12.0},
+    ])
+    assert got == {"episodes": 0, "unrecovered": 0, "superseded": 1}
+    # without the supersede the same journal reads unrecovered
+    got2 = summarize_mttr([{"action": "detect", "worker": 1, "time": 10.0}])
+    assert got2["unrecovered"] == 1 and got2["superseded"] == 0
+
+
+def test_grown_worker_seeded_dir_still_integrity_checked(tmp_path):
+    """A grown worker torn down before its first step has no metrics
+    to splice — but its SEEDED checkpoint dir must still pass invariant
+    5 (a source file copied while torn is exactly what the digest
+    sidecars exist to catch)."""
+    outcome = {"outcome": "completed", "step": 10, "target": 10,
+               "num_workers": 1, "final_world": 2,
+               "supervisor": {"quorum": 1, "max_restarts_per_worker": 2}}
+    trial = tmp_path / "g"
+    _write_trial(trial, workers=(0,), journal_lines=[
+        {"event": "reconfigure", "layer": "cluster", "action": "reshape",
+         "old_world": 1, "new_world": 2, "workers": [0, 1], "dropped": [],
+         "grown": {"1": 0}},
+        {"event": "reconfigure", "layer": "supervisor",
+         "action": "relaunched", "old_world": 1, "new_world": 2,
+         "workers": [0, 1], "via": {"0": "respawn", "1": "respawn"}},
+    ])
+    # worker1: seeded artifacts, NO step records; digest sidecar lies
+    d1 = trial / "worker1"
+    d1.mkdir()
+    (d1 / "ckpt-00000005.msgpack").write_bytes(b"torn-mid-copy")
+    (d1 / "ckpt-00000005.msgpack.sha256").write_text("0" * 64)
+    (trial / "state.json").write_text(json.dumps(
+        {"phase": "running",
+         "workers": [{"worker": 0, "pid": None,
+                      "logdir": str(trial / "worker0")},
+                     {"worker": 1, "pid": None, "logdir": str(d1)}]}))
+    got = check_run(trial, outcome=outcome)
+    assert got["verdicts"]["checkpoint_integrity"] == "fail"
+    assert any(v["worker"] == 1 and v["invariant"] == "checkpoint_integrity"
+               for v in got["violations"])
+
+
+def test_grow_seeds_only_newest_checkpoint(tmp_path):
+    """Backend-level grow seeding resolves the checkpoint.json pointer
+    and copies ONLY that step's artifacts — every retained cadence save
+    would multiply disk per grown worker and leave stale steps as
+    silent fallback candidates."""
+    c = _cluster(tmp_path, num_workers=1)
+    c.create()
+    src = c.cfg.worker_dir(0)
+    src.mkdir(parents=True, exist_ok=True)
+    for s in (5, 10):
+        (src / f"ckpt-{s:08d}.msgpack").write_bytes(b"x" * 8)
+        (src / f"ckpt-{s:08d}.msgpack.sha256").write_text("y")
+    (src / "checkpoint.json").write_text(json.dumps(
+        {"latest_step": 10, "latest_path": "ckpt-00000010.msgpack"}))
+    rec = c.reconfigure(2)
+    assert rec["grown"] == {"1": 0}
+    seeded = sorted(p.name for p in c.cfg.worker_dir(1).glob("ckpt*"))
+    assert seeded == ["ckpt-00000010.msgpack",
+                      "ckpt-00000010.msgpack.sha256"]
+    assert (c.cfg.worker_dir(1) / "checkpoint.json").exists()
+    c.delete()
+
+
+def test_reconfigure_invariant_skipped_without_world_change(tmp_path):
+    outcome = {"outcome": "completed", "step": 10, "target": 10,
+               "num_workers": 1,
+               "supervisor": {"quorum": 1, "max_restarts_per_worker": 2}}
+    trial = tmp_path / "plain"
+    _write_trial(trial, workers=(0,))
+    got = check_run(trial, outcome=outcome)
+    assert got["verdicts"]["reconfigure"] == "skipped"
