@@ -856,6 +856,149 @@ def bench_restart_latency() -> dict:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_serving_latency() -> dict:
+    """Online serving tier (ROADMAP item 3), gated end-to-end in one
+    process: a real ServingReplica (socket, admission queue, bucketed
+    batching) under a closed-loop load sweep at fixed offered load,
+    with checkpoint publishes landing MID-SWEEP so the zero-drop
+    hot-swap is measured, not assumed.
+
+    Two sweeps, same replica, same offered load (closed loop,
+    ``concurrency`` in-flight):
+
+      * **steady** — no publishes: the p50/p99 baseline.
+      * **swap** — a publisher thread pushes a fresh checkpoint every
+        ~300 ms: every request still gets a terminal outcome
+        (dropped == 0), at least one hot-swap actually happened
+        (≥2 distinct model steps served), and p99 stays bounded
+        relative to steady (≤ max(5×, +250 ms) — the swap may cost a
+        batch boundary, never a stall).
+
+    The reject rate at this load is reported (expected 0 under the
+    default queue depth — admission control only sheds when the queue
+    is actually full)."""
+    import shutil
+    import tempfile
+    import threading
+    from pathlib import Path
+
+    from distributedmnist_tpu.core.config import ExperimentConfig, ServeConfig
+    from distributedmnist_tpu.servesvc.client import ServeClient
+    from distributedmnist_tpu.servesvc.loadgen import make_input_fn, run_load
+    from distributedmnist_tpu.servesvc.server import ServingReplica
+    from distributedmnist_tpu.train.loop import Trainer
+
+    workdir = Path(tempfile.mkdtemp(prefix="dmt_serving_bench_"))
+    staging = workdir / "staging"
+    publish = workdir / "publish"
+    publish.mkdir()
+    concurrency, n_requests = 4, 200
+
+    def publish_step(step: int) -> None:
+        """Atomically publish one staged checkpoint into the serve
+        dir: artifact + digest sidecar first, pointer last (the same
+        write order the trainer uses)."""
+        name = f"ckpt-{step:08d}.msgpack"
+        shutil.copy2(staging / name, publish / name)
+        shutil.copy2(staging / (name + ".sha256"),
+                     publish / (name + ".sha256"))
+        tmp = publish / "checkpoint.json.tmp"
+        tmp.write_text(json.dumps({"latest_step": step,
+                                   "latest_path": name,
+                                   "written_at": time.time()}))
+        tmp.replace(publish / "checkpoint.json")
+
+    replica = None
+    try:
+        # stage a stream of checkpoints (one short deterministic run)
+        cfg = ExperimentConfig().override({
+            "data.dataset": "synthetic", "data.batch_size": 32,
+            "data.synthetic_train_size": 256,
+            "data.synthetic_test_size": 64,
+            "model.compute_dtype": "float32", "train.max_steps": 60,
+            "train.train_dir": str(staging), "train.log_every_steps": 20,
+            "train.save_interval_steps": 10,
+            "train.async_checkpoint": False,
+            "train.save_results_period": 0})
+        Trainer(cfg).run()
+        staged = sorted(int(p.name[5:13])
+                        for p in staging.glob("ckpt-*.msgpack"))
+        publish_step(staged[0])
+
+        replica = ServingReplica(
+            publish, serve_dir=workdir / "replica",
+            scfg=ServeConfig(poll_secs=0.1), cfg=cfg)
+        replica.start()
+        client = ServeClient([("127.0.0.1", replica.bound_port)],
+                             deadline_s=5.0)
+        make_input = make_input_fn(
+            list(replica.model.input_shape),
+            str(np.dtype(replica.model.input_dtype)))
+
+        # warm every bucket shape the sweep can hit (compile once):
+        # sequential singles hit bucket 1, the concurrent burst hits
+        # the 2/4 buckets the closed loop gathers
+        run_load(client, 8, 1, make_input)
+        run_load(client, 8 * concurrency, concurrency, make_input)
+
+        steady = run_load(client, n_requests, concurrency, make_input,
+                          journal_path=workdir / "loadgen_steady.jsonl")
+
+        stop_pub = threading.Event()
+
+        def publisher() -> None:
+            for step in staged[1:]:
+                if stop_pub.is_set():
+                    return
+                time.sleep(0.3)
+                publish_step(step)
+
+        pub_thread = threading.Thread(target=publisher, daemon=True)
+        swaps_before = replica.swaps
+        pub_thread.start()
+        swap = run_load(client, n_requests, concurrency, make_input,
+                        journal_path=workdir / "loadgen_swap.jsonl")
+        stop_pub.set()
+        pub_thread.join(timeout=10)
+        swaps_during = replica.swaps - swaps_before
+
+        p99_base = steady["latency_ms"]["p99"]
+        p99_swap = swap["latency_ms"]["p99"]
+        p99_bound = max(5.0 * p99_base, p99_base + 250.0)
+        no_drop = (swap["dropped"] == 0 and swap["errors"] == 0
+                   and steady["dropped"] == 0)
+        swapped = (swaps_during >= 1
+                   and len(swap["model_steps_served"]) >= 2)
+        p99_ok = p99_swap <= p99_bound
+        passes = bool(no_drop and swapped and p99_ok)
+        return {
+            "metric": "serving_latency",
+            "value": p99_swap, "unit": "ms p99 across hot-swaps",
+            "passes_gate": passes,
+            "detail": {
+                "gate": ("zero dropped/errored requests AND >=1 mid-"
+                         "sweep hot-swap (>=2 model steps served) AND "
+                         "p99_swap <= max(5x, +250ms) of steady p99"),
+                "offered_load": {"concurrency": concurrency,
+                                 "requests_per_sweep": n_requests},
+                "steady": steady, "swap_sweep": swap,
+                "swaps_during_sweep": swaps_during,
+                "p99_steady_ms": p99_base, "p99_swap_ms": p99_swap,
+                "p99_bound_ms": round(p99_bound, 3),
+                "no_drop_ok": bool(no_drop),
+                "swap_happened_ok": bool(swapped),
+                "p99_gate_ok": bool(p99_ok),
+                "reject_rate": swap["reject_rate"],
+                **_env_stamp()}}
+    finally:
+        if replica is not None:
+            try:
+                replica.stop()
+            except Exception:
+                pass
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_input_pipeline_overlap() -> dict:
     """Dispatch-ahead input pipeline: a deliberately slow host loader
     feeding the flagship CNN step, sync-feed (next → device_put →
@@ -988,7 +1131,7 @@ def main() -> None:
     for case in (bench_transformer_flash, bench_flash_long_context,
                  bench_mode_overhead, bench_native_loader,
                  bench_input_pipeline_overlap, bench_weight_update_sharding,
-                 bench_restart_latency):
+                 bench_restart_latency, bench_serving_latency):
         if not want(case):
             continue
         try:

@@ -349,6 +349,13 @@ class TrainConfig:
     # the guard exists for transient corruption, not bad hyperparams).
     nan_guard: bool = True
     nan_guard_max_rollbacks: int = 2
+    # Deliberate per-step wall throttle (sleep after each step). 0 =
+    # off (every real run). What the serving chaos trials use to make
+    # a CPU-fast synthetic trainer publish checkpoints across a WALL
+    # window long enough for serving replicas to boot, swap, and be
+    # faulted mid-traffic — numerics are untouched, only the publish
+    # cadence stretches.
+    step_pace_ms: float = 0.0
     # Preemption handling: SIGTERM/SIGINT flush the AsyncCheckpointer
     # and stop the loop cleanly; the CLI then exits with
     # resumable_exit_code (default 75 = EX_TEMPFAIL) so a supervisor
@@ -356,6 +363,35 @@ class TrainConfig:
     # when run() executes on the main thread.
     handle_preemption: bool = True
     resumable_exit_code: int = 75
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Online serving tier (``servesvc/``): a replica that hot-follows
+    the trainer's published checkpoints and serves inference over a
+    local socket. Robustness knobs, not an endpoint zoo:
+
+    * ``queue_depth`` is the ADMISSION bound — a full queue load-sheds
+      with a typed ``overloaded`` reject immediately instead of
+      queueing into unbounded latency.
+    * ``max_batch`` is the compiled batch ceiling; pending requests are
+      gathered into the smallest power-of-2 bucket that fits and padded
+      to it, so the step function compiles once per bucket shape.
+    * ``default_deadline_ms`` bounds a request that named no deadline;
+      expired requests get a typed ``deadline_exceeded`` reject, never
+      silent starvation.
+    * ``poll_secs`` is the checkpoint hot-follow cadence (the swap
+      itself is double-buffered: the in-flight batch finishes on the
+      old weights, then the reference flips atomically).
+    """
+
+    host: str = "127.0.0.1"
+    port: int = 0            # 0 = ephemeral; the bound port lands in serve.json
+    max_batch: int = 16
+    queue_depth: int = 64
+    batch_window_ms: float = 2.0   # gather window after the first request
+    poll_secs: float = 0.25
+    default_deadline_ms: float = 2000.0
 
 
 @dataclass(frozen=True)
@@ -385,6 +421,7 @@ class ExperimentConfig:
     compile: CompileConfig = field(default_factory=CompileConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     eval: EvalConfig = field(default_factory=EvalConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
 
     # ---- construction helpers -------------------------------------------------
 
@@ -459,6 +496,7 @@ _SECTION_TYPES = {
     ("ExperimentConfig", "compile"): CompileConfig,
     ("ExperimentConfig", "train"): TrainConfig,
     ("ExperimentConfig", "eval"): EvalConfig,
+    ("ExperimentConfig", "serve"): ServeConfig,
 }
 
 

@@ -90,41 +90,34 @@ class Evaluator:
             cfg.model.num_classes, cfg.model.seq_len, cfg.model.vocab_size)
         self.eval_fn = build_eval_step(self.model, cfg, self.topo)
         self.template = init_train_state(self.model, cfg, self.topo)
-        self.last_step_evaluated = -1
+        # the shared hot-follow loop (train/checkpoint.py): atomic
+        # pointer read, step-advanced check, skip-and-retry on a torn /
+        # corrupt / GC-raced artifact — the same follower the serving
+        # tier (servesvc) runs on
+        self.follower = ckpt.CheckpointFollower(self.train_dir)
         self._sink: JsonlSink | None = None
         self._tb = None
 
-    def _config_from_checkpoint(self) -> ExperimentConfig:
-        """Wait for the first checkpoint, then adopt its saved config.
+    @property
+    def last_step_evaluated(self) -> int:
+        return self.follower.last_step
 
-        Reads only the checkpoint's JSON ``extra`` payload — no state
-        template needed, so this works for any model/optimizer shape
-        (a resnet20/momentum/interval run, not just the default CNN)."""
-        deadline = time.time() + 600.0
-        while time.time() < deadline:
-            try:
-                out = ckpt.read_checkpoint_extra(self.train_dir)
-            except (OSError, ValueError, KeyError) as e:
-                # mid-replace read on a shared fs / torn file — this is
-                # a long-running service, retry on the next poll
-                logger.warning("checkpoint read failed (%s); retrying", e)
-                out = None
-            if out is not None:
-                extra, _ = out
-                if "config" in extra:
-                    return ExperimentConfig.from_dict(extra["config"])
-                logger.warning("checkpoint has no saved config; using defaults")
-                return ExperimentConfig()
-            time.sleep(1.0)
-        raise TimeoutError(f"no checkpoint appeared in {self.train_dir} within 600s")
+    def _config_from_checkpoint(self) -> ExperimentConfig:
+        """Wait for the first checkpoint, then adopt its saved config
+        (the shared checkpoint-layer bootstrap the serving tier uses
+        too — reads only the JSON ``extra`` payload, no state
+        template, so any model/optimizer shape works)."""
+        return ckpt.wait_for_run_config(self.train_dir)
 
     # ------------------------------------------------------------------
 
     def evaluate_checkpoint(self, step: int | None = None) -> dict | None:
-        """Evaluate one checkpoint (≙ do_eval, src/nn_eval.py:49-115)."""
+        """Evaluate one checkpoint (≙ do_eval, src/nn_eval.py:49-115).
+        Skips (returns None) when the artifact is unreadable — the
+        standalone-call convenience; the service loop gets the same
+        policy from the shared follower."""
         try:
-            restored = ckpt.restore_checkpoint(self.train_dir, self.template,
-                                               step)
+            return self._read_and_eval(step)
         except (OSError, ValueError, KeyError) as e:
             # The trainer's checkpoint GC can unlink this step between
             # our latest_checkpoint_step poll and the read (or a shared
@@ -132,6 +125,14 @@ class Evaluator:
             logger.warning("checkpoint step=%s unreadable (%s); skipping",
                            step, e)
             return None
+
+    def _read_and_eval(self, step: int | None) -> dict | None:
+        """Restore + evaluate, RAISING on an unreadable artifact —
+        the ``read`` the follower wraps with skip-and-retry
+        (CheckpointCorruptError subclasses ValueError, so a failed
+        digest flows into the same skip path as a torn msgpack)."""
+        restored = ckpt.restore_checkpoint(self.train_dir, self.template,
+                                           step)
         if restored is None:
             return None
         state, _, at_step = restored
@@ -163,8 +164,19 @@ class Evaluator:
             self._tb.flush()
         return result
 
+    def poll_once(self) -> dict | None:
+        """One follow tick: evaluate the newest checkpoint iff its step
+        advanced past the last one evaluated; a torn/corrupt/unlinked
+        artifact is skipped (retried next tick), never fatal."""
+        if self.follower.newest_step() is None:
+            logger.info("no checkpoint yet in %s", self.train_dir)
+            return None
+        return self.follower.poll(lambda step: self._read_and_eval(step))
+
     def run(self) -> list[dict]:
-        """Poll loop (≙ evaluate(), src/nn_eval.py:117-140)."""
+        """Poll loop (≙ evaluate(), src/nn_eval.py:117-140) — the
+        shared follower (train/checkpoint.py CheckpointFollower) owns
+        the pointer-read / step-advanced / skip-and-retry discipline."""
         ecfg = self.eval_cfg
         eval_dir = Path(ecfg.eval_dir)
         eval_dir.mkdir(parents=True, exist_ok=True)
@@ -174,14 +186,9 @@ class Evaluator:
         results: list[dict] = []
         try:
             while True:
-                step = ckpt.latest_checkpoint_step(self.train_dir)
-                if step is not None and step != self.last_step_evaluated:
-                    out = self.evaluate_checkpoint(step)
-                    if out is not None:
-                        self.last_step_evaluated = step
-                        results.append(out)
-                elif step is None:
-                    logger.info("no checkpoint yet in %s", self.train_dir)
+                out = self.poll_once()
+                if out is not None:
+                    results.append(out)
                 if ecfg.run_once and results:
                     break
                 if ecfg.max_evals and len(results) >= ecfg.max_evals:

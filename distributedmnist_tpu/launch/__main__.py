@@ -110,6 +110,61 @@ def _eval(args) -> None:
                       single_device=args.single_device).run()
 
 
+def _serve(args) -> None:
+    """The serving-replica payload (`launch serve`): hot-follow the
+    publish dir's checkpoints and serve inference over a local socket
+    — the process the cluster's serving payload verb spawns. Runs on
+    ONE ambient device (no simulated mesh, no collectives), adopting
+    the model/config from the checkpoint itself like the evaluator."""
+    import dataclasses
+
+    from ..servesvc.server import ServingReplica, wait_for_run_config
+
+    cfg = wait_for_run_config(args.train_dir)
+    overrides = {k: getattr(args, k) for k in
+                 ("host", "port", "max_batch", "queue_depth",
+                  "batch_window_ms", "poll_secs", "default_deadline_ms")
+                 if getattr(args, k) is not None}
+    scfg = dataclasses.replace(cfg.serve, **overrides)
+    ServingReplica(args.train_dir, serve_dir=args.serve_dir,
+                   scfg=scfg, cfg=cfg).serve_forever()
+
+
+def _serve_load(args) -> None:
+    """Closed-loop load generator (`launch serve-load`): drive a
+    serving cluster through the round-robin failover shim, journal
+    every request's terminal outcome, print the latency summary."""
+    import time as _time
+
+    from ..servesvc.client import ServeClient, discover_endpoints
+    from ..servesvc.loadgen import make_input_fn, run_load
+
+    if args.endpoints:
+        eps = [tuple(e.rsplit(":", 1)) for e in args.endpoints.split(",")]
+        eps = [(h, int(p)) for h, p in eps]
+        endpoints_fn = lambda: eps  # noqa: E731
+    elif args.cluster_root:
+        root = args.cluster_root
+        endpoints_fn = lambda: discover_endpoints(root)  # noqa: E731
+    else:
+        raise SystemExit("serve-load needs --endpoints or --cluster-root")
+    client = ServeClient(endpoints_fn, deadline_s=args.deadline_s,
+                         max_attempts=args.max_attempts)
+    deadline = _time.time() + args.ready_timeout_s
+    meta = None
+    while meta is None and _time.time() < deadline:
+        meta = client.meta(deadline_s=2.0)
+        if meta is None:
+            _time.sleep(0.5)
+    if meta is None:
+        raise SystemExit(f"no serving replica became ready within "
+                         f"{args.ready_timeout_s:.0f}s")
+    make_input = make_input_fn(meta["input_shape"], meta["input_dtype"])
+    summary = run_load(client, args.requests, args.concurrency,
+                       make_input, journal_path=args.out)
+    print(json.dumps(summary))
+
+
 def _sweep(args) -> None:
     from ..core.mesh import initialize_distributed
     initialize_distributed()
@@ -388,6 +443,57 @@ def main(argv=None) -> None:
                          "training mesh (DP checkpoints only; the lean "
                          "co-located mode)")
     pe.set_defaults(fn=_eval)
+
+    pv = sub.add_parser(
+        "serve", help="serving replica: hot-follow a train_dir's "
+                      "published checkpoints (digest-verified, torn "
+                      "publishes skipped) and serve inference over a "
+                      "local socket with admission control and "
+                      "zero-drop weight hot-swap")
+    pv.add_argument("--train_dir", required=True,
+                    help="the publish dir to follow")
+    pv.add_argument("--serve-dir", default=".",
+                    help="where serve.json / serve_log.jsonl / "
+                         "heartbeats land (the worker's own logdir "
+                         "under a cluster)")
+    pv.add_argument("--host", default=None)
+    pv.add_argument("--port", type=int, default=None,
+                    help="0 = ephemeral (the bound port is published "
+                         "in serve.json)")
+    pv.add_argument("--max-batch", type=int, default=None, dest="max_batch")
+    pv.add_argument("--queue-depth", type=int, default=None,
+                    dest="queue_depth",
+                    help="admission bound; a full queue load-sheds "
+                         "with a typed reject")
+    pv.add_argument("--batch-window-ms", type=float, default=None,
+                    dest="batch_window_ms")
+    pv.add_argument("--poll-secs", type=float, default=None,
+                    dest="poll_secs", help="checkpoint-follow cadence")
+    pv.add_argument("--default-deadline-ms", type=float, default=None,
+                    dest="default_deadline_ms")
+    pv.set_defaults(fn=_serve)
+
+    pl = sub.add_parser(
+        "serve-load", help="closed-loop load generator over a serving "
+                           "cluster (round-robin failover shim, "
+                           "per-request journal, p50/p99 summary)")
+    pl.add_argument("--cluster-root", default=None,
+                    help="LocalProcessCluster root to discover "
+                         "worker*/serve.json endpoints from")
+    pl.add_argument("--endpoints", default=None,
+                    help="comma-separated host:port list (overrides "
+                         "--cluster-root)")
+    pl.add_argument("--requests", type=int, default=200)
+    pl.add_argument("--concurrency", type=int, default=2)
+    pl.add_argument("--deadline-s", type=float, default=5.0,
+                    dest="deadline_s")
+    pl.add_argument("--max-attempts", type=int, default=6,
+                    dest="max_attempts")
+    pl.add_argument("--ready-timeout-s", type=float, default=120.0,
+                    dest="ready_timeout_s")
+    pl.add_argument("--out", default="loadgen.jsonl",
+                    help="per-request journal path")
+    pl.set_defaults(fn=_serve_load)
 
     ps = sub.add_parser("sweep", help="run a directory of experiment configs")
     ps.add_argument("--configs", required=True)

@@ -86,6 +86,31 @@ _TRAIN_PAYLOAD = (
     "train.log_every_steps=1 train.save_interval_steps={save} "
     "train.async_checkpoint=false train.save_results_period=0")
 
+# Serving-mode publisher (worker 0 of a serving trial, and the serving
+# campaign's fault-free reference): a deterministic single-device
+# trainer whose job is to PUBLISH a stream of checkpoints across a
+# wall window long enough for serving replicas to boot, hot-swap, and
+# be faulted mid-traffic — train.step_pace_ms stretches the publish
+# cadence without touching numerics, so the publisher still reproduces
+# the reference bitwise.
+_SERVE_PUBLISHER_PAYLOAD = (
+    "python -m distributedmnist_tpu.launch train "
+    "train.train_dir=. data.dataset=synthetic data.batch_size=32 "
+    "data.synthetic_train_size=256 data.synthetic_test_size=64 "
+    "model.compute_dtype=float32 "
+    "train.max_steps={max_steps} train.step_pace_ms={pace} "
+    "train.log_every_steps=1 train.save_interval_steps={save} "
+    "train.async_checkpoint=false train.save_results_period=0")
+
+# Serving replicas (workers 1..N of a serving trial): hot-follow the
+# publisher's logdir. Their ``train_log.jsonl`` carries heartbeat
+# records whose step is the terminal-outcome count, so the supervisor's
+# liveness/stall/progress machinery applies unchanged.
+_SERVE_PAYLOAD = (
+    "python -m distributedmnist_tpu.launch serve "
+    "--train_dir ../worker0 --serve-dir . --port 0 "
+    "--poll-secs 0.2 --queue-depth {queue} --max-batch 8")
+
 
 @dataclasses.dataclass(frozen=True)
 class ChaosFault:
@@ -246,6 +271,64 @@ def generate_schedule(seed: int, trial: int, num_workers: int,
     return ChaosSchedule(seed=seed, trial=trial, faults=tuple(faults))
 
 
+def generate_serving_schedule(seed: int, trial: int,
+                              serve_workers: list[int],
+                              serve_window: tuple[int, int],
+                              publish_window: tuple[int, int],
+                              max_faults: int = 3, min_faults: int = 1,
+                              stall_ms_range: tuple[float, float]
+                              = (1000.0, 4000.0)) -> ChaosSchedule:
+    """Serving-mode schedules (deterministic in (seed, trial)); its
+    own generator rather than a branch of the training one because the
+    fault GRAMMAR differs:
+
+    * ALWAYS one kill of a serving replica — mid-traffic replica loss
+      is the scenario the tier exists for; every seeded serving trial
+      must exercise the failover/restart/zero-drop path.
+    * ALWAYS one corruption of the PUBLISHED checkpoint (worker 0's
+      newest artifact), UNPAIRED with any kill: in the serving tier
+      the torn publish is observed by the replicas' checkpoint
+      FOLLOWERS on their next poll — nothing needs to die for the
+      fault to be hit, unlike training, where only a restarted
+      worker's restore reads the file.
+    * Extra kills/hangs/stalls on serving replicas up to
+      ``max_faults`` intensity units. Kill/hang/stall trigger steps
+      are in HEARTBEAT units (terminal outcomes served by that
+      replica); the corruption step is in publisher train steps.
+    """
+    import random
+    rng = random.Random(seed * 2_000_003 + trial)
+    s_lo, s_hi = serve_window
+    p_lo, p_hi = publish_window
+    faults: list[ChaosFault] = [
+        ChaosFault(kind="kill", worker=rng.choice(list(serve_workers)),
+                   step=rng.randint(s_lo, max(s_lo, s_hi))),
+        ChaosFault(kind="corrupt", worker=0,
+                   step=rng.randint(p_lo, max(p_lo, p_hi))),
+    ]
+    used = {("kill", faults[0].worker)}
+    n = rng.randint(min_faults, max(min_faults, max_faults))
+    combos = [(kind, w) for kind in ("kill", "hang", "stall")
+              for w in serve_workers]
+    rng.shuffle(combos)
+    units = 1  # the mandatory kill; the mandatory corrupt rides free
+    for kind, w in combos:
+        if units >= n:
+            break
+        if (kind, w) in used:
+            continue
+        if kind == "stall" and ("hang", w) in used:
+            continue  # the stall's timed SIGCONT would resume the hang
+        if kind == "hang" and ("stall", w) in used:
+            continue
+        used.add((kind, w))
+        step = rng.randint(s_lo, max(s_lo, s_hi))
+        ms = rng.uniform(*stall_ms_range) if kind == "stall" else 0.0
+        faults.append(ChaosFault(kind=kind, worker=w, step=step, ms=ms))
+        units += 1
+    return ChaosSchedule(seed=seed, trial=trial, faults=tuple(faults))
+
+
 def count_fired_faults(trial_dir: Path,
                        schedule: ChaosSchedule) -> dict[str, Any]:
     """Scheduled-vs-actually-fired accounting for one trial, from the
@@ -301,13 +384,29 @@ class ChaosConfig:
     until_step: int = 40
     num_workers: int = 2
     workdir: str = "/tmp/dmt_chaos"
-    # "train" = real `launch train` workers (all five invariants apply,
+    # "train" = real `launch train` workers (all invariants apply,
     # incl. bitwise determinism); "shell" = the cheap 20-steps/s shell
     # loop (no real checkpoints: determinism reports skipped) — for CI
-    # smoke and generator/checker development
+    # smoke and generator/checker development; "serving" = the online
+    # serving tier under fire: worker 0 is a paced checkpoint PUBLISHER
+    # (`launch train`), workers 1..serve_replicas are serving replicas
+    # (`launch serve` hot-following ../worker0), a closed-loop load
+    # generator drives traffic for the whole trial, and the three
+    # serving invariants (exactly-one terminal outcome, never serve a
+    # failed digest, monotone served step) replay alongside the
+    # training ones on the publisher
     payload: str = "train"
     train_command: str = ""     # override; "" = built-in payload
     save_interval_steps: int = 5
+    # -- serving mode ---------------------------------------------------
+    serve_replicas: int = 2
+    load_concurrency: int = 2
+    request_deadline_s: float = 3.0
+    publisher_pace_ms: float = 150.0   # publish-cadence stretch (wall only)
+    serve_queue_depth: int = 32
+    # kill/hang/stall triggers on serving replicas are in HEARTBEAT
+    # units (terminal outcomes that replica produced)
+    serve_fault_window: tuple[int, int] = (5, 40)
     # schedule intensity
     max_faults: int = 3
     min_faults: int = 1
@@ -360,6 +459,8 @@ class ChaosConfig:
             raise ClusterError(f"unknown chaos config keys: {sorted(unknown)}")
         if "stall_ms_range" in d and d["stall_ms_range"] is not None:
             d["stall_ms_range"] = tuple(d["stall_ms_range"])
+        if "serve_fault_window" in d:
+            d["serve_fault_window"] = tuple(d["serve_fault_window"])
         if "resize_worlds" in d and d["resize_worlds"] is not None:
             d["resize_worlds"] = tuple(int(w) for w in d["resize_worlds"])
         return cls(**d)
@@ -411,13 +512,41 @@ class ChaosConfig:
             worlds.append(self.num_workers + 1)  # warm grow
         return tuple(worlds)
 
-    def resolved_train_command(self) -> str:
+    def resolved_train_command(self, measured_boot_s: float | None = None
+                               ) -> str:
         if self.train_command:
             return self.train_command
         if self.payload == "shell":
             return _SHELL_PAYLOAD.format(limit=self.until_step + 20)
+        if self.payload == "serving":
+            # worker 0 AND the campaign reference: the paced publisher.
+            # The pace ADAPTS to the measured boot (the reference run's
+            # spawn→first-log cost): serving replicas pay roughly the
+            # same jax boot the publisher did, so the publishing window
+            # must outlast it with margin or a loaded box finishes the
+            # trial before any replica ever serves — the same
+            # derive-from-reality move the stall timeout makes.
+            pace = self.publisher_pace_ms
+            if measured_boot_s is not None and measured_boot_s > 0:
+                floor = 2500.0 * measured_boot_s / max(1, self.until_step)
+                pace = min(2000.0, max(pace, floor))
+            return _SERVE_PUBLISHER_PAYLOAD.format(
+                max_steps=self.until_step, pace=round(pace, 1),
+                save=self.save_interval_steps)
         return _TRAIN_PAYLOAD.format(max_steps=self.until_step,
                                      save=self.save_interval_steps)
+
+    def resolved_worker_commands(self) -> dict[str, str]:
+        """Per-worker payload overrides — serving mode's mixed roster
+        (publisher + replicas); empty for the uniform payloads."""
+        if self.payload != "serving":
+            return {}
+        serve = _SERVE_PAYLOAD.format(queue=self.serve_queue_depth)
+        return {str(k): serve for k in range(1, self.trial_num_workers())}
+
+    def trial_num_workers(self) -> int:
+        return (1 + self.serve_replicas if self.payload == "serving"
+                else self.num_workers)
 
     def step_window(self) -> tuple[int, int]:
         lo = max(2, self.save_interval_steps + 1)
@@ -444,18 +573,27 @@ class ChaosCampaign:
 
     def _run_trial(self, rel: str, plan: FaultPlan, seed: int,
                    num_workers: int,
-                   measured_boot_s: float | None = None) -> dict[str, Any]:
+                   measured_boot_s: float | None = None,
+                   serving: bool = False) -> dict[str, Any]:
         """Execute one supervised run under ``plan`` in
         ``<root>/<rel>``; returns the outcome record (also written to
         ``outcome.json`` there so the invariant replay is
         artifact-only). ``measured_boot_s``: a previous run's observed
         spawn→first-log cost — lets the stall timeout derive from the
-        measured boot instead of the hardcoded worst case."""
+        measured boot instead of the hardcoded worst case.
+
+        ``serving``: the mixed serving roster (worker 0 publishes,
+        workers 1..N serve) with the closed-loop load generator driving
+        traffic for the whole supervised window; progress toward the
+        target counts from the PUBLISHER only (a replica's heartbeat
+        step is its request counter, not run progress)."""
         cfg = self.cfg
         target = cfg.until_step
         lcfg = LocalClusterConfig(
             name=rel, num_workers=num_workers, workdir=str(cfg.root),
-            train_command=cfg.resolved_train_command(),
+            train_command=cfg.resolved_train_command(measured_boot_s),
+            worker_commands=(cfg.resolved_worker_commands()
+                             if serving else {}),
             # ONE cache for the whole campaign, not per-trial: the
             # reference's cold compile warms every later boot
             compile_cache=cfg.share_compile_cache,
@@ -484,16 +622,27 @@ class ChaosCampaign:
                               if self.reference_dir else None),
         }
         t0 = time.monotonic()
+        loadgen_thread: Any = None
+        load_stop = None
+        load_result: dict[str, Any] = {}
         try:
             # inside the try: a spawn that fails halfway (fork pressure
             # mid-campaign) must still hit the kill_all/close below, or
             # already-spawned detached workers outlive the campaign
             cluster.create()
             cluster.run_train()
+            if serving:
+                loadgen_thread, load_stop = self._start_loadgen(
+                    lcfg, load_result)
             got = sup.supervise_until_step(
                 target, poll_secs=cfg.resolved_poll_secs(),
-                timeout_secs=cfg.trial_timeout_s)
+                timeout_secs=cfg.trial_timeout_s,
+                target_worker=0 if serving else None)
             outcome.update(outcome="completed", step=got["step"])
+            if serving:
+                self._stop_serving(cluster, sup, num_workers,
+                                   loadgen_thread, load_stop)
+                loadgen_thread = None
             self._drain(cluster, sup)
             # the drain may have closed recovery episodes the
             # supervised loop left open (a worker restarted near
@@ -516,12 +665,80 @@ class ChaosCampaign:
                            step=None, error=str(e),
                            recovery=sup.summary())
         finally:
+            if loadgen_thread is not None:  # error path: stop the load
+                load_stop.set()
+                loadgen_thread.join(timeout=30)
             cluster.kill_all()
             executor.close()
+        if serving:
+            outcome["mode"] = "serving"
+            outcome["serve_workers"] = list(range(1, num_workers))
+            outcome["serving"] = load_result.get("summary")
         outcome["duration_s"] = round(time.monotonic() - t0, 3)
         (lcfg.root / "outcome.json").write_text(
             json.dumps(outcome, indent=2, default=str))
         return outcome
+
+    # -- serving-mode plumbing ------------------------------------------
+
+    def _start_loadgen(self, lcfg: LocalClusterConfig,
+                       load_result: dict[str, Any]):
+        """Launch the closed-loop load generator on a background
+        thread: wait for the first replica to become ready (its
+        ``serve.json`` + a meta answer), then drive traffic through
+        the round-robin failover shim until told to stop. The
+        per-request journal lands in ``<trial root>/loadgen.jsonl`` —
+        the artifact the serving invariants replay."""
+        import threading
+
+        from ..servesvc.client import ServeClient, discover_endpoints
+        from ..servesvc.loadgen import make_input_fn, run_load
+        cfg = self.cfg
+        root = lcfg.root
+        stop = threading.Event()
+
+        def drive() -> None:
+            client = ServeClient(lambda: discover_endpoints(root),
+                                 deadline_s=cfg.request_deadline_s,
+                                 max_attempts=6)
+            meta = None
+            while meta is None and not stop.is_set():
+                meta = client.meta(deadline_s=1.0)
+                if meta is None:
+                    time.sleep(0.5)
+            if meta is None:
+                load_result["summary"] = None  # nothing ever came up
+                return
+            load_result["summary"] = run_load(
+                client, None, cfg.load_concurrency,
+                make_input_fn(meta["input_shape"], meta["input_dtype"]),
+                journal_path=root / "loadgen.jsonl", stop_event=stop)
+
+        t = threading.Thread(target=drive, daemon=True, name="chaos-load")
+        t.start()
+        return t, stop
+
+    def _stop_serving(self, cluster: LocalProcessCluster,
+                      sup: ClusterSupervisor, num_workers: int,
+                      loadgen_thread, load_stop) -> None:
+        """Orderly serving teardown once the publisher hit its target:
+        stop the offered load, then SIGTERM the replicas so their
+        graceful drain sheds anything still queued with a TYPED reject
+        (the zero-drop evidence), closing any recovery episodes their
+        heartbeats can prove resumed."""
+        load_stop.set()
+        loadgen_thread.join(timeout=60)
+        st = cluster.status()
+        if st is not None and sup.open_episodes:
+            for w in st["workers"]:
+                if w["worker"] in sup.open_episodes:
+                    resumed = worker_resumed_step_since_spawn(
+                        w, events=("step", "heartbeat"))
+                    if resumed is not None:
+                        sup.close_episode(w["worker"], *resumed)
+        for k in range(1, num_workers):
+            cluster.stop_all(worker=str(k))
+        cluster.wait_drained(15.0)
 
     # spawn-observation helpers: the logic moved to launch/cluster.py
     # (worker_logged_since_spawn / worker_resumed_step_since_spawn) so
@@ -642,19 +859,33 @@ class ChaosCampaign:
                 cfg.resolved_stall_timeout_s())
 
         reproducer: dict[str, Any] | None = None
+        serving = cfg.payload == "serving"
+        nw = cfg.trial_num_workers()
         for t in range(cfg.trials):
-            schedule = generate_schedule(
-                cfg.seed, t, cfg.num_workers, cfg.step_window(),
-                max_faults=cfg.max_faults, min_faults=cfg.min_faults,
-                stall_ms_range=cfg.resolved_stall_ms_range(),
-                resize_worlds=cfg.resolved_resize_worlds(),
-                resize_prob=cfg.resize_prob)
+            if serving:
+                schedule = generate_serving_schedule(
+                    cfg.seed, t, list(range(1, nw)),
+                    cfg.serve_fault_window, cfg.step_window(),
+                    max_faults=cfg.max_faults, min_faults=cfg.min_faults,
+                    stall_ms_range=cfg.resolved_stall_ms_range())
+            else:
+                schedule = generate_schedule(
+                    cfg.seed, t, cfg.num_workers, cfg.step_window(),
+                    max_faults=cfg.max_faults, min_faults=cfg.min_faults,
+                    stall_ms_range=cfg.resolved_stall_ms_range(),
+                    resize_worlds=cfg.resolved_resize_worlds(),
+                    resize_prob=cfg.resize_prob)
             logger.info("chaos trial %d/%d: %s", t + 1, cfg.trials,
                         schedule.describe())
             rel = f"trial{t:03d}"
+            # the serving kwarg rides only when armed: train/shell
+            # campaigns keep the historical _run_trial signature (test
+            # harnesses subclass and override it)
             outcome = self._run_trial(rel, schedule.to_fault_plan(),
-                                      cfg.seed, cfg.num_workers,
-                                      measured_boot_s=self._measured_boot_s)
+                                      cfg.seed, nw,
+                                      measured_boot_s=self._measured_boot_s,
+                                      **({"serving": True} if serving
+                                         else {}))
             if outcome.get("boot_s"):
                 # warm boots keep tightening (never loosening past the
                 # cap) the next trial's detection window
@@ -682,6 +913,10 @@ class ChaosCampaign:
                    "reconfigures": ((outcome.get("recovery") or {})
                                     .get("reconfigure") or {}).get("count", 0),
                    "final_world": outcome.get("final_world"),
+                   # serving mode: the load generator's one-line sweep
+                   # summary (requests, dropped, p50/p99, rejects,
+                   # model steps served) rides into the campaign report
+                   "serving": outcome.get("serving"),
                    "verdicts": check["verdicts"],
                    "violations": check["violations"]}
             if check["violations"] and cfg.shrink and reproducer is None:
@@ -717,8 +952,11 @@ class ChaosCampaign:
             probes[0] += 1
             logger.info("shrink probe %s: %s", rel, cand.describe())
             outcome = self._run_trial(rel, cand.to_fault_plan(), cfg.seed,
-                                      cfg.num_workers,
-                                      measured_boot_s=self._measured_boot_s)
+                                      cfg.trial_num_workers(),
+                                      measured_boot_s=self._measured_boot_s,
+                                      **({"serving": True}
+                                         if cfg.payload == "serving"
+                                         else {}))
             got = check_run(cfg.root / rel, outcome=outcome,
                             reference_dir=self.reference_dir)
             return bool({v["invariant"] for v in got["violations"]}

@@ -92,7 +92,8 @@ def worker_logged_since_spawn(worker: dict) -> bool:
         return False  # no log at all yet: definitely still booting
 
 
-def worker_resumed_step_since_spawn(worker: dict
+def worker_resumed_step_since_spawn(worker: dict,
+                                    events: tuple[str, ...] = ("step",)
                                     ) -> tuple[int, float | None] | None:
     """``(step, record_time)`` proving this worker's CURRENT
     incarnation produced a training step, or None if it has not
@@ -107,7 +108,12 @@ def worker_resumed_step_since_spawn(worker: dict
     first-moved-step; its own ``time`` stamp (when the step happened,
     vs when this sweep observed it) is what MTTR-style latencies close
     on. A torn newest line returns None — the next-intact record
-    behind it may belong to the previous incarnation; wait a tick."""
+    behind it may belong to the previous incarnation; wait a tick.
+
+    ``events``: the record types that count as this payload's progress
+    — ``("step",)`` for trainers; a serving payload's progress records
+    are ``event: "heartbeat"`` (terminal-outcome count), so its
+    callers pass ``("step", "heartbeat")``."""
     if not worker_logged_since_spawn(worker):
         return None
     log = Path(worker["logdir"]) / "train_log.jsonl"
@@ -127,8 +133,8 @@ def worker_resumed_step_since_spawn(worker: dict
             return None  # torn newest write — cannot prove resume yet
         if not isinstance(rec, dict):
             return None
-        if rec.get("event", "step") != "step":
-            return None  # newest intact record: compile, not a step
+        if rec.get("event", "step") not in events:
+            return None  # newest intact record: compile, not progress
         step = rec.get("step")
         if not isinstance(step, int):
             return None
@@ -386,6 +392,16 @@ class LocalClusterConfig:
         "data.synthetic_train_size=256 data.synthetic_test_size=64 "
         "model.compute_dtype=float32 train.max_steps=50 "
         "train.log_every_steps=5 train.save_interval_steps=0")
+    # Per-worker payload overrides keyed by STRING worker index (JSON
+    # object keys): a mixed cluster — e.g. the serving topology, where
+    # worker 0 is the checkpoint PUBLISHER (`launch train`) and
+    # workers 1..N are serving replicas (`launch serve` following
+    # ../worker0) — under one roster, one supervisor, one fault plan.
+    # Workers not named here run train_command. Restarts respawn the
+    # worker's OWN command; grown (reconfigure) workers get the
+    # default.
+    worker_commands: dict[str, str] = dataclasses.field(
+        default_factory=dict)
     # Warm standbys (ROADMAP item 5): the command a PRE-BOOTED spare
     # process runs — it must honor the DMT_STANDBY_ACTIVATION protocol
     # (boot, precompile, touch <activation>.ready, park until the
@@ -429,6 +445,9 @@ class LocalClusterConfig:
 
     def resolved_standby_command(self) -> str:
         return self.standby_command or self.train_command
+
+    def command_for(self, k: int) -> str:
+        return self.worker_commands.get(str(k), self.train_command)
 
 
 class LocalProcessCluster(ClusterBackend):
@@ -562,10 +581,11 @@ class LocalProcessCluster(ClusterBackend):
         k = w["worker"]
         logdir = Path(w["logdir"])
         logdir.mkdir(parents=True, exist_ok=True)
+        command = self.cfg.command_for(k)
         log_fh = open(logdir / "train_stdout.log", "ab")
         try:
             proc = subprocess.Popen(
-                ["sh", "-c", self.cfg.train_command],
+                ["sh", "-c", command],
                 cwd=logdir, env=self._worker_env(k),
                 stdout=log_fh, stderr=subprocess.STDOUT,
                 start_new_session=True)
@@ -578,7 +598,7 @@ class LocalProcessCluster(ClusterBackend):
         # worker's train_log.jsonl mtime against it
         w["spawned_at"] = time.time()
         self.exec.journal({"event": "spawn", "worker": k, "pid": proc.pid,
-                           "command": self.cfg.train_command})
+                           "command": command})
 
     def run_train(self) -> None:
         """Spawn one REAL detached process per worker (≙ run_tf's
@@ -596,7 +616,7 @@ class LocalProcessCluster(ClusterBackend):
         delay_s = self.exec.fault_plan.command_delay_s("run")
         for w in state["workers"]:
             if self.exec.dry_run:  # record the spawn argv, don't Popen
-                self.exec.run(["sh", "-c", self.cfg.train_command],
+                self.exec.run(["sh", "-c", self.cfg.command_for(w["worker"])],
                               verb="run")
                 continue
             if w.get("pid"):
@@ -623,7 +643,7 @@ class LocalProcessCluster(ClusterBackend):
             raise ClusterError(f"restart_worker({k}): no such worker")
         w = sel[0]
         if self.exec.dry_run:
-            self.exec.run(["sh", "-c", self.cfg.train_command], verb="run")
+            self.exec.run(["sh", "-c", self.cfg.command_for(k)], verb="run")
             return
         if w.get("pid"):
             self._kill_pid(w["pid"], "kill")
@@ -868,6 +888,17 @@ class LocalProcessCluster(ClusterBackend):
         when the spare originally booted — its old log silence was
         parking, not stalling."""
         if self.exec.dry_run:
+            return False
+        if str(k) in self.cfg.worker_commands:
+            # mixed roster: this slot runs an OVERRIDDEN payload (e.g.
+            # a serving replica in a publisher+replicas cluster), but
+            # the parked spare runs the standby/default payload —
+            # promoting it would silently swap the worker's role.
+            # Cold respawn of the worker's OWN command is the correct
+            # recovery. (The standby command legitimately differs from
+            # train_command — it is the parked-protocol variant of the
+            # DEFAULT payload, which is exactly what overridden slots
+            # are not.)
             return False
         state = self._read_state()
         sel = self._select(state["workers"], str(k))
@@ -1279,6 +1310,11 @@ def main(argv: list[str] | None = None) -> None:
                         "drops the highest ids / dead workers first; "
                         "grow seeds fresh workers from a survivor's "
                         "newest checkpoint)")
+    p.add_argument("--target-worker", type=int, default=None,
+                   help="for supervise: count progress toward "
+                        "--until-step from THIS worker's log only "
+                        "(mixed-payload clusters: the publisher, not a "
+                        "serving replica's request counter)")
     p.add_argument("--seed", type=int, default=None,
                    help="for supervise/chaos: schedule + retry-jitter "
                         "seed, stamped on every journaled recovery/chaos "
@@ -1287,10 +1323,13 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--trials", type=int, default=None,
                    help="for chaos: number of seeded fault-schedule "
                         "trials")
-    p.add_argument("--payload", default=None, choices=["train", "shell"],
+    p.add_argument("--payload", default=None,
+                   choices=["train", "shell", "serving"],
                    help="for chaos: real `launch train` workers (all "
-                        "invariants incl. bitwise determinism) or the "
-                        "cheap shell loop (CI smoke)")
+                        "invariants incl. bitwise determinism), the "
+                        "cheap shell loop (CI smoke), or the serving "
+                        "tier under fire (publisher + serve replicas + "
+                        "closed-loop load, serving invariants checked)")
     p.add_argument("--chaos-config", default=None,
                    help="for chaos: ChaosConfig JSON (flags above "
                         "override it)")
@@ -1387,7 +1426,8 @@ def main(argv: list[str] | None = None) -> None:
                 try:
                     got = sup.supervise_until_step(
                         args.until_step, poll_secs=poll_secs,
-                        timeout_secs=args.poll_timeout_s)
+                        timeout_secs=args.poll_timeout_s,
+                        target_worker=args.target_worker)
                 finally:
                     backend.kill_all()
                 print(json.dumps({"reconfigure": rec, **got}))
@@ -1397,7 +1437,8 @@ def main(argv: list[str] | None = None) -> None:
         else:
             print(json.dumps(sup.run_until_step(
                 args.until_step, poll_secs=poll_secs,
-                timeout_secs=args.poll_timeout_s)))
+                timeout_secs=args.poll_timeout_s,
+                target_worker=args.target_worker)))
     elif args.action == "poll":
         if args.until_step is not None:
             print(json.dumps(wait_until_step(

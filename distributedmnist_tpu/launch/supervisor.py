@@ -404,19 +404,32 @@ class ClusterSupervisor:
     # -- the supervised run ---------------------------------------------
 
     def run_until_step(self, target: int, poll_secs: float = 1.0,
-                       timeout_secs: float = 24 * 3600.0) -> dict[str, Any]:
+                       timeout_secs: float = 24 * 3600.0,
+                       target_worker: int | None = None) -> dict[str, Any]:
         """Launch training and supervise it to ``target`` steps; the
         cluster is stopped on EVERY exit path (success, below-quorum
         failure, timeout, Ctrl-C)."""
         self.backend.run_train()
         try:
-            return self.supervise_until_step(target, poll_secs, timeout_secs)
+            return self.supervise_until_step(target, poll_secs, timeout_secs,
+                                             target_worker=target_worker)
         finally:
             self.backend.kill_all()
 
     def supervise_until_step(self, target: int, poll_secs: float = 1.0,
-                             timeout_secs: float = 24 * 3600.0
+                             timeout_secs: float = 24 * 3600.0,
+                             target_worker: int | None = None
                              ) -> dict[str, Any]:
+        """Supervise the running cluster until ``target`` progress.
+
+        ``target_worker``: count progress toward the target from ONE
+        worker's log only (liveness/stall/restart still cover every
+        worker). What a mixed-payload cluster needs — a serving
+        topology's replicas heartbeat their request counts into the
+        same progress channel, and the run is over when the
+        PUBLISHER's train step hits the target, not when some busy
+        replica has served ``target`` requests. None = the fastest
+        worker (the historical behavior)."""
         cfg = self.cfg
         deadline = time.monotonic() + timeout_secs
         pending_restart: dict[int, float] = {}  # worker -> due monotonic
@@ -542,9 +555,16 @@ class ClusterSupervisor:
                                 and progress.get(k, -1) >= 0):
                             self.close_reconfigure(k, progress[k])
                             break
-            best_step = got["step"]
-            if progress:
-                best_step = max(best_step, *progress.values())
+            if target_worker is not None:
+                # poll()'s headline step is worker 0's tail; only trust
+                # it for the target when worker 0 IS the target worker
+                best_step = (progress or {}).get(
+                    target_worker,
+                    got["step"] if target_worker == 0 else -1)
+            else:
+                best_step = got["step"]
+                if progress:
+                    best_step = max(best_step, *progress.values())
             if best_step >= target:
                 if progress is None and watch_resume:
                     # no per-worker log signal on this backend: a

@@ -39,6 +39,21 @@ def classification_eval_metrics(logits: jax.Array, labels: jax.Array,
     return jnp.sum(correct * w), jnp.sum(nll * w), jnp.sum(w)
 
 
+def classification_predictions(logits: jax.Array) -> jax.Array:
+    """Softmax class probabilities [batch, classes] — the inference
+    export every classifier serves (≙ cnn.predictions; defined here so
+    EVERY registered model carries one and ``servesvc`` stays
+    model-agnostic the way the trainer is)."""
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def lm_predictions(logits: jax.Array) -> jax.Array:
+    """Next-token distribution [batch, vocab] for a causal LM: softmax
+    over the LAST position's logits — the decode-step export (what an
+    online serving tier samples/ranks from)."""
+    return jax.nn.softmax(logits[:, -1], axis=-1)
+
+
 def lm_eval_metrics(logits: jax.Array, labels: jax.Array,
                     weight: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Token-level eval sums for a [batch, seq, vocab] causal LM
@@ -72,6 +87,12 @@ class Model:
     input_shape: tuple[int, ...]
     input_dtype: Any = jnp.float32
     eval_metrics: Callable[..., tuple] = classification_eval_metrics
+    # ``predictions(logits) -> per-example distribution`` — the
+    # inference export (softmax class probs for classifiers, next-token
+    # distribution for LMs). Every registered model carries one, so the
+    # serving tier (servesvc) builds its predict step from the registry
+    # exactly the way the trainer builds its train step.
+    predictions: Callable[[jax.Array], jax.Array] = classification_predictions
     # Sharded-execution support (long-context models only):
     # factory(seq_axis, model_axis, expert_axis=None) -> apply(params,
     # tokens_local, positions_local) -> logits_local, run inside
@@ -224,6 +245,7 @@ def _mnist_cnn(cfg: ModelConfig) -> Model:
                  loss=cnn.loss_fn, accuracy=cnn.accuracy,
                  input_shape=(cfg.image_size, cfg.image_size, cfg.num_channels),
                  partition_rules=replicated_partition_rules,
+                 predictions=cnn.predictions,  # the reference's export
                  uses_dropout=cfg.dropout_rate > 0.0)
 
 
@@ -468,6 +490,7 @@ def _transformer(cfg: ModelConfig) -> Model:
                  loss=transformer.loss_fn, accuracy=transformer.accuracy,
                  input_shape=(cfg.seq_len,), input_dtype=jnp.int32,
                  eval_metrics=lm_eval_metrics,
+                 predictions=lm_predictions,
                  sharded_apply_factory=sharded_apply_factory,
                  partition_rules=transformer_partition_rules(cfg.num_experts),
                  has_aux=moe, aux_weight=cfg.moe_aux_weight,

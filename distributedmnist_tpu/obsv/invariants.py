@@ -57,7 +57,8 @@ from typing import Any, Callable
 from .report import load_jsonl
 
 INVARIANTS = ("terminal_state", "metrics_log", "determinism",
-              "causality", "checkpoint_integrity", "reconfigure")
+              "causality", "checkpoint_integrity", "reconfigure",
+              "serve_outcomes", "serve_digest", "serve_monotone")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -417,6 +418,176 @@ def check_reconfigure(trial_dir: str | Path, outcome: dict,
 
 
 # ---------------------------------------------------------------------------
+# (7-9) serving invariants (the online inference tier under chaos)
+# ---------------------------------------------------------------------------
+
+_SERVE_CKPT_STEP = None  # lazy import of the checkpoint name regex
+
+
+def _ckpt_name_step(name: str) -> int | None:
+    global _SERVE_CKPT_STEP
+    if _SERVE_CKPT_STEP is None:
+        import re
+        _SERVE_CKPT_STEP = re.compile(r"^ckpt-(\d+)")
+    m = _SERVE_CKPT_STEP.match(name)
+    return int(m.group(1)) if m else None
+
+
+def check_serving(trial_dir: str | Path, outcome: dict,
+                  journal_records: list[dict]
+                  ) -> tuple[list[Violation], bool, set[int]]:
+    """The three serving invariants, replayed from artifacts alone.
+    Returns ``(violations, applicable, serve_workers)`` — not
+    applicable (all three verdicts: skipped) for trials with no
+    serving tier.
+
+    * **serve_outcomes** — every request the load generator issued has
+      EXACTLY one terminal outcome (response or typed reject/error; no
+      silent drops), and on every serving replica the admitted-request
+      count equals the admitted-terminal count — except on replicas
+      the run faulted or restarted (a SIGKILLed replica's in-flight
+      admissions legitimately died with it; the CLIENT side still had
+      to reach a terminal outcome for those requests via failover).
+    * **serve_digest** — no weight swap installed a checkpoint AFTER
+      the injector journaled tearing that step's artifact: digest
+      verification (plus fallback-to-previous-loadable) must have
+      skipped it. Swaps predating the tear served the then-intact
+      bytes and are correct.
+    * **serve_monotone** — each replica's journaled ``weight_swap``
+      step series is monotone non-decreasing (across restarts too: the
+      publisher's steps only advance).
+    """
+    trial_dir = Path(trial_dir)
+    serve_workers = {int(k) for k in (outcome.get("serve_workers") or [])}
+    if not serve_workers:
+        # artifact-only replay: a serving replica is a worker dir with
+        # a serve journal
+        serve_workers = {k for k, d in _worker_dirs(trial_dir).items()
+                        if (d / "serve_log.jsonl").exists()}
+    loadgen = trial_dir / "loadgen.jsonl"
+    applicable = bool(serve_workers) or loadgen.exists()
+    if not applicable:
+        return [], False, set()
+    out: list[Violation] = []
+
+    # ---- (a) client side: issued ↔ exactly-one-terminal ----------------
+    load_records = load_jsonl(loadgen, "load")
+    issued: dict[Any, int] = {}
+    terminal: dict[Any, int] = {}
+    for r in load_records:
+        if r.get("action") == "issue":
+            issued[r.get("id")] = issued.get(r.get("id"), 0) + 1
+        elif r.get("action") == "outcome":
+            terminal[r.get("id")] = terminal.get(r.get("id"), 0) + 1
+    dropped = [i for i, n in issued.items() if terminal.get(i, 0) < n]
+    doubled = [i for i, n in terminal.items() if n > issued.get(i, 0)]
+    if dropped:
+        out.append(Violation(
+            "serve_outcomes",
+            f"{len(dropped)} issued request(s) never reached a terminal "
+            f"outcome (silent drop), e.g. ids {sorted(dropped)[:5]}"))
+    if doubled:
+        out.append(Violation(
+            "serve_outcomes",
+            f"request ids with more terminal outcomes than issues: "
+            f"{sorted(doubled)[:5]} — the load journal lies"))
+
+    # workers the run faulted/killed/restarted: their in-flight
+    # admissions may legitimately have died server-side
+    exempt: set[int] = set()
+    for r in journal_records:
+        if r.get("event") == "fault" and isinstance(r.get("worker"), int):
+            exempt.add(r["worker"])
+        if (r.get("event") == "recovery" and r.get("action") == "restart"
+                and isinstance(r.get("worker"), int)):
+            exempt.add(r["worker"])
+
+    corrupt_faults = [
+        r for r in journal_records
+        if r.get("event") == "fault"
+        and r.get("action") == "corrupt_latest_checkpoint"
+        and r.get("target")]
+
+    workers = _worker_dirs(trial_dir)
+    for k in sorted(serve_workers):
+        d = workers.get(k)
+        if d is None:
+            continue
+        recs = load_jsonl(d / "serve_log.jsonl", "serve")
+        if not recs:
+            out.append(Violation(
+                "serve_outcomes", "serving replica left no serve journal "
+                "at all", k))
+            continue
+        # ---- (a) server side: admits ↔ admitted terminals ------------
+        admits = sum(1 for r in recs if r.get("action") == "admit")
+        responds = sum(1 for r in recs if r.get("action") == "respond")
+        admitted_rejects = sum(1 for r in recs
+                               if r.get("action") == "reject"
+                               and r.get("admitted"))
+        if k not in exempt and admits != responds + admitted_rejects:
+            out.append(Violation(
+                "serve_outcomes",
+                f"{admits} admitted request(s) but "
+                f"{responds + admitted_rejects} admitted-terminal "
+                "outcome(s) on an unfaulted replica — admitted work "
+                "vanished without a response or a typed reject", k))
+        # ---- (b) never serve a torn publish --------------------------
+        swaps = [r for r in recs if r.get("action") == "weight_swap"]
+        for sw in swaps:
+            step = sw.get("step")
+            at = sw.get("time", sw.get("ts"))
+            for f in corrupt_faults:
+                torn_step = _ckpt_name_step(str(f["target"]))
+                f_at = f.get("ts", f.get("time"))
+                if not (torn_step is not None and step == torn_step
+                        and isinstance(at, (int, float))
+                        and isinstance(f_at, (int, float))):
+                    continue
+                # the flip is a batch boundary AFTER the read: judge
+                # by when the READ began (time − swap_ms), not when
+                # the reference flipped — bytes read intact before
+                # the tear may legitimately install after it. Any
+                # swap whose read STARTED after the tear had to pass
+                # the digest check on torn bytes: impossible unless
+                # verification failed.
+                swap_ms = sw.get("swap_ms")
+                read_at = (at - swap_ms / 1e3
+                           if isinstance(swap_ms, (int, float)) else at)
+                if read_at > f_at:
+                    out.append(Violation(
+                        "serve_digest",
+                        f"weight_swap installed step {step} (read began "
+                        f"t={read_at:.3f}) AFTER its artifact "
+                        f"{f['target']} was torn at t={f_at:.3f} — "
+                        "digest verification failed to refuse it", k))
+        # ---- (c) served step monotone non-decreasing -----------------
+        # Per INCARNATION: the journal is append-mode across restarts,
+        # and a restarted replica whose newest publish was torn
+        # legitimately boots on the previous loadable step (its
+        # ``initial: true`` swap may land BELOW the dead incarnation's
+        # last step — that is digest verification working, not a
+        # regression). Within an incarnation, backwards is always a
+        # violation.
+        prev: int | None = None
+        for sw in swaps:
+            step = sw.get("step")
+            if not isinstance(step, int):
+                continue
+            if sw.get("initial"):
+                prev = step  # a fresh incarnation restarts the scan
+                continue
+            if prev is not None and step < prev:
+                out.append(Violation(
+                    "serve_monotone",
+                    f"served model step went backwards across swaps: "
+                    f"{prev} -> {step}", k))
+                break
+            prev = step
+    return out, True, serve_workers
+
+
+# ---------------------------------------------------------------------------
 # whole-run replay
 # ---------------------------------------------------------------------------
 
@@ -493,6 +664,12 @@ def check_run(trial_dir: str | Path, outcome: dict | None = None,
     violations += reconf_violations
     if not reconf_applicable:
         skipped.add("reconfigure")
+    serve_violations, serving_applicable, serve_workers = \
+        check_serving(trial_dir, outcome, journal_all)
+    violations += serve_violations
+    if not serving_applicable:
+        skipped.update(("serve_outcomes", "serve_digest",
+                        "serve_monotone"))
 
     restarts_by_worker: dict[int, int] = {}
     for r in recovery:
@@ -502,6 +679,10 @@ def check_run(trial_dir: str | Path, outcome: dict | None = None,
 
     det_checked = 0
     for k, d in sorted(workers.items()):
+        if k in serve_workers:
+            # serving replicas have no train series or checkpoints —
+            # their artifacts are replayed by check_serving above
+            continue
         # the trainer stamps event:"step"; minimal payloads (chaos
         # shell smoke, the reference's own tools) may write bare
         # {"step": N, ...} records — both are the metrics series

@@ -1,0 +1,29 @@
+"""Online serving tier (ROADMAP item 3): the millions-of-users path.
+
+``servesvc`` is to inference what ``launch/supervisor.py`` is to
+training: the process that keeps answering while individual pieces
+misbehave. A :class:`~.server.ServingReplica` hot-follows the
+trainer's published checkpoints (digest-verified; a torn publish is
+skipped, never served), admits requests over a local socket behind a
+BOUNDED queue (overload load-sheds with a typed reject instead of
+queueing into unbounded latency), pads/buckets dynamic request batches
+to compiled shapes, and hot-swaps weights on publish without dropping
+a single in-flight request (double-buffered params: the in-flight
+batch drains on the old weights, then the reference flips atomically
+and the swap is journaled).
+
+N replicas run under the same :class:`~..launch.supervisor.
+ClusterSupervisor` liveness/restart/standby machinery as trainers
+(payload verb ``launch serve``), behind the round-robin failover
+:class:`~.client.ServeClient` shim — the backup-workers discipline of
+the source paper (arXiv:1604.00981), applied to the request path the
+way TF-Replicator (arXiv:1902.00465) treats serving replicas as just
+another resource shape behind one recovery surface.
+"""
+
+from .client import ServeClient, discover_endpoints
+from .loadgen import run_load
+from .server import ServingReplica
+
+__all__ = ["ServingReplica", "ServeClient", "discover_endpoints",
+           "run_load"]

@@ -1,0 +1,178 @@
+"""Round-robin failover client shim for the serving tier.
+
+The backup-workers idea (arXiv:1604.00981) on the request path: N
+interchangeable serving replicas behind one client, and a request
+never depends on any SINGLE replica staying alive. Each request gets a
+**deadline** and a **bounded retry budget with backoff**; a dead,
+hung, restarting, or load-shedding replica costs one attempt and the
+next attempt goes to the next replica (round-robin). Every request
+ends in exactly one TERMINAL outcome:
+
+* ``{"status": "ok", ...}`` — a replica answered,
+* ``{"status": "rejected", ...}`` — a replica answered with a
+  non-retryable typed reject (``bad_request``, ``deadline_exceeded``),
+* ``{"status": "error", "reason": "unavailable" | "deadline_exceeded"}``
+  — the budget or the deadline ran out before any replica answered.
+
+``overloaded`` and ``shutting_down`` rejects ARE retried (that replica
+shed load; a sibling may have room) — admission control composes with
+failover instead of surfacing every shed to the caller.
+
+Endpoints come from a list or a zero-arg callable returning one — the
+callable form re-resolves on every attempt, so a replica restarted
+onto a fresh ephemeral port (its ``serve.json`` rewritten by the new
+incarnation) is picked up without any client restart.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import socket
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable
+
+from ..core.log import get_logger
+
+logger = get_logger("serveclient")
+
+RETRYABLE_REJECTS = ("overloaded", "shutting_down")
+
+
+def discover_endpoints(cluster_root: str | Path) -> list[dict[str, Any]]:
+    """Scan a LocalProcessCluster root for replicas' ``serve.json``
+    ready files → ``[{"worker", "host", "port"}, ...]`` (sorted by
+    worker id). Torn/stale files are skipped — the shim treats a bad
+    endpoint as one failed attempt anyway."""
+    out: list[dict[str, Any]] = []
+    root = Path(cluster_root)
+    for f in sorted(root.glob("worker*/serve.json")):
+        name = f.parent.name[len("worker"):]
+        try:
+            d = json.loads(f.read_text())
+            out.append({"worker": int(name) if name.isdigit() else name,
+                        "host": d["host"], "port": int(d["port"])})
+        except (OSError, ValueError, KeyError):
+            continue
+    return out
+
+
+class ServeClient:
+    """Thread-safe round-robin client over N serving replicas."""
+
+    def __init__(self,
+                 endpoints: (list[dict] | list[tuple]
+                             | Callable[[], list[dict]]),
+                 deadline_s: float = 2.0, max_attempts: int = 4,
+                 backoff_s: float = 0.05):
+        self._endpoints_fn = (endpoints if callable(endpoints)
+                              else (lambda: endpoints))
+        self.deadline_s = deadline_s
+        self.max_attempts = max_attempts
+        self.backoff_s = backoff_s
+        self._rr = itertools.count()
+        self._lock = threading.Lock()
+
+    def _next_endpoint(self) -> tuple[str, int] | None:
+        eps = self._endpoints_fn()
+        if not eps:
+            return None
+        with self._lock:
+            i = next(self._rr)
+        ep = eps[i % len(eps)]
+        if isinstance(ep, dict):
+            return ep["host"], int(ep["port"])
+        return ep[0], int(ep[1])
+
+    def _one_attempt(self, payload: bytes, host: str, port: int,
+                     timeout_s: float) -> dict:
+        with socket.create_connection((host, port),
+                                      timeout=timeout_s) as conn:
+            conn.settimeout(timeout_s)
+            conn.sendall(payload)
+            buf = b""
+            while b"\n" not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise OSError("connection closed mid-response")
+                buf += chunk
+            return json.loads(buf.decode())
+
+    def request(self, inputs, request_id=None,
+                deadline_s: float | None = None) -> dict:
+        """One request → one terminal outcome dict (never raises for
+        server/network trouble; see module docstring). The outcome
+        carries ``latency_ms``, ``attempts``, and the answering
+        replica's ``endpoint`` when one answered."""
+        deadline_s = self.deadline_s if deadline_s is None else deadline_s
+        t0 = time.time()
+        deadline = t0 + deadline_s
+        last_reason = "unavailable"
+        attempts = 0
+        while attempts < self.max_attempts:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                last_reason = "deadline_exceeded"
+                break
+            ep = self._next_endpoint()
+            if ep is None:
+                attempts += 1
+                time.sleep(min(self.backoff_s * attempts, remaining))
+                continue
+            host, port = ep
+            attempts += 1
+            req = {"id": request_id, "inputs": inputs,
+                   "deadline_ms": round(remaining * 1e3, 1)}
+            try:
+                resp = self._one_attempt(
+                    (json.dumps(req) + "\n").encode(), host, port,
+                    timeout_s=remaining)
+            except (OSError, ValueError) as e:
+                logger.debug("attempt %d via %s:%d failed: %s",
+                             attempts, host, port, e)
+                time.sleep(min(self.backoff_s * attempts,
+                               max(0.0, deadline - time.time())))
+                continue
+            status = resp.get("status")
+            out = {**resp, "attempts": attempts,
+                   "endpoint": f"{host}:{port}",
+                   "latency_ms": round((time.time() - t0) * 1e3, 3)}
+            if status == "ok":
+                return out
+            if (status == "rejected"
+                    and resp.get("reason") in RETRYABLE_REJECTS):
+                # that replica shed load / is draining — its sibling
+                # may have room; the budget bounds how long we hedge
+                time.sleep(min(self.backoff_s * attempts,
+                               max(0.0, deadline - time.time())))
+                continue
+            if status == "rejected":
+                return out  # typed, non-retryable — terminal
+            return out      # unknown status: surface it verbatim
+        return {"id": request_id, "status": "error", "reason": last_reason,
+                "attempts": attempts,
+                "latency_ms": round((time.time() - t0) * 1e3, 3)}
+
+    def meta(self, deadline_s: float | None = None) -> dict | None:
+        """Model metadata from any live replica (input shape/dtype —
+        what a load generator needs to fabricate requests), or None."""
+        deadline_s = self.deadline_s if deadline_s is None else deadline_s
+        deadline = time.time() + deadline_s
+        payload = (json.dumps({"meta": True}) + "\n").encode()
+        for _ in range(self.max_attempts):
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                return None
+            ep = self._next_endpoint()
+            if ep is None:
+                time.sleep(min(self.backoff_s, remaining))
+                continue
+            try:
+                return self._one_attempt(payload, ep[0], ep[1],
+                                         timeout_s=remaining)
+            except (OSError, ValueError):
+                time.sleep(min(self.backoff_s,
+                               max(0.0, deadline - time.time())))
+        return None

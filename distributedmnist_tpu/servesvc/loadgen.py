@@ -1,0 +1,164 @@
+"""Closed-loop load generator for the serving tier.
+
+``concurrency`` workers each keep exactly one request in flight
+(closed-loop: the next request is issued only when the previous one
+reached a terminal outcome), so offered load is bounded and the
+latency distribution is measurable instead of collapsing into queueing
+divergence. Every request is journaled twice — ``issue`` when sent,
+``outcome`` when terminal — which is the artifact the serving
+invariants replay: a request with no outcome is a DROP, and the whole
+point of the serving tier is that there are none.
+
+The summary carries p50/p99 latency over successful responses, the
+reject/error tallies by typed reason, the distinct model steps the
+responses were served from (evidence that a hot-swap happened
+mid-sweep), and ``dropped`` (issued − terminal; must be 0).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable
+
+import numpy as np
+
+from ..core.log import JsonlSink, get_logger
+from .client import ServeClient
+
+logger = get_logger("loadgen")
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    idx = min(len(sorted_vals) - 1,
+              max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def make_input_fn(shape, dtype: str, vocab: int = 256
+                  ) -> Callable[[int], list]:
+    """Deterministic per-request inputs: request ``i`` is always the
+    same array, so any replica (and any retry) sees identical bytes."""
+    shape = tuple(shape)
+    np_dtype = np.dtype(dtype)
+
+    def make(i: int) -> list:
+        rng = np.random.default_rng(i)
+        if np_dtype.kind in "iu":
+            return rng.integers(0, vocab, size=shape).astype(
+                np_dtype).tolist()
+        return (rng.random(size=shape).astype(np_dtype) - 0.5).tolist()
+
+    return make
+
+
+def run_load(client: ServeClient, num_requests: int | None,
+             concurrency: int, make_input: Callable[[int], Any],
+             journal_path: str | Path | None = None,
+             stop_event: threading.Event | None = None,
+             deadline_s: float | None = None) -> dict[str, Any]:
+    """Drive the cluster closed-loop until ``num_requests`` terminal
+    outcomes (or ``stop_event``, whichever first; one of the two must
+    be provided). Returns the summary; journals to ``journal_path``."""
+    if num_requests is None and stop_event is None:
+        raise ValueError("run_load needs num_requests or stop_event")
+    sink = JsonlSink(journal_path) if journal_path is not None else None
+    sink_lock = threading.Lock()
+    counter = iter(range(1 << 62))
+    outcomes: list[dict] = []
+    out_lock = threading.Lock()
+    issued = [0]
+    t_start = time.time()
+
+    def journal(rec: dict) -> None:
+        if sink is not None:
+            with sink_lock:
+                sink.write(rec)
+
+    def should_stop() -> bool:
+        return stop_event is not None and stop_event.is_set()
+
+    def worker() -> None:
+        while not should_stop():
+            with out_lock:
+                if num_requests is not None and issued[0] >= num_requests:
+                    return
+                issued[0] += 1
+                rid = next(counter)
+            journal({"event": "load", "action": "issue", "id": rid,
+                     "time": time.time()})
+            got = client.request(make_input(rid), request_id=rid,
+                                 deadline_s=deadline_s)
+            rec = {"event": "load", "action": "outcome", "id": rid,
+                   "time": time.time(), "status": got.get("status"),
+                   "reason": got.get("reason"),
+                   "model_step": got.get("model_step"),
+                   "attempts": got.get("attempts"),
+                   "endpoint": got.get("endpoint"),
+                   "latency_ms": got.get("latency_ms")}
+            journal(rec)
+            with out_lock:
+                outcomes.append(rec)
+
+    threads = [threading.Thread(target=worker, daemon=True,
+                                name=f"loadgen-{i}")
+               for i in range(max(1, concurrency))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        # closed-loop workers exit on their own (count reached or stop
+        # set); the join bounds a wedged worker by its own deadline
+        t.join()
+    duration = time.time() - t_start
+    if sink is not None:
+        sink.close()
+    return summarize_outcomes(outcomes, issued[0], duration)
+
+
+def summarize_outcomes(outcomes: list[dict], issued: int,
+                       duration_s: float) -> dict[str, Any]:
+    ok = [r for r in outcomes if r.get("status") == "ok"]
+    rejected = [r for r in outcomes if r.get("status") == "rejected"]
+    errors = [r for r in outcomes if r.get("status") == "error"]
+    lat = sorted(r["latency_ms"] for r in ok
+                 if isinstance(r.get("latency_ms"), (int, float)))
+    by_reason: dict[str, int] = {}
+    for r in rejected + errors:
+        key = f"{r.get('status')}:{r.get('reason')}"
+        by_reason[key] = by_reason.get(key, 0) + 1
+    steps = sorted({r["model_step"] for r in ok
+                    if isinstance(r.get("model_step"), int)})
+    out: dict[str, Any] = {
+        "issued": issued,
+        "terminal": len(outcomes),
+        # issued − terminal: every request MUST reach a terminal
+        # outcome; nonzero here is the silent drop the tier forbids
+        "dropped": issued - len(outcomes),
+        "responses": len(ok),
+        "rejected": len(rejected),
+        "errors": len(errors),
+        "by_reason": by_reason,
+        "reject_rate": round(len(rejected) / max(1, len(outcomes)), 4),
+        "duration_s": round(duration_s, 3),
+        "throughput_rps": round(len(outcomes) / max(duration_s, 1e-9), 2),
+        "model_steps_served": steps,
+    }
+    if lat:
+        out["latency_ms"] = {"p50": _percentile(lat, 0.50),
+                             "p90": _percentile(lat, 0.90),
+                             "p99": _percentile(lat, 0.99),
+                             "max": lat[-1],
+                             "mean": round(sum(lat) / len(lat), 3)}
+    return out
+
+
+def load_outcomes(journal_path: str | Path) -> tuple[list[dict],
+                                                     list[dict]]:
+    """(issues, outcomes) from a loadgen journal — what the serving
+    invariants replay."""
+    from ..obsv.report import load_jsonl
+    records = load_jsonl(journal_path, "load")
+    return ([r for r in records if r.get("action") == "issue"],
+            [r for r in records if r.get("action") == "outcome"])

@@ -1,0 +1,537 @@
+"""The serving replica process.
+
+One replica = one socket + one bounded admission queue + one batcher
+thread + one checkpoint-follower thread. The robustness contract
+(checked post-run by ``obsv/invariants.py``'s serving invariants):
+
+* **Exactly one terminal outcome per admitted request** — a response
+  or a TYPED reject (``overloaded`` / ``deadline_exceeded`` /
+  ``bad_request`` / ``shutting_down``); a graceful stop drains the
+  queue by rejecting, never by dropping.
+* **Never serve a checkpoint that failed digest verification** — the
+  weight path is ``train/checkpoint.py`` ``restore_checkpoint`` with
+  its fallback-to-previous-loadable-step, so a torn or corrupt publish
+  is skipped (and journaled) while the replica keeps serving the
+  previous weights.
+* **Served model step is monotone non-decreasing across swaps** — a
+  swap only installs a strictly newer step.
+
+Wire protocol: one JSON line per connection each way (the client shim
+opens a connection per request — serving rates here are bounded by
+model compute, not connection setup).
+
+  request:  {"id": ..., "inputs": [...], "deadline_ms": ...}
+            {"meta": true}   → model metadata, never queued
+  response: {"id": ..., "status": "ok", "model_step": N,
+             "prediction": k, "probs": [...]}
+            {"id": ..., "status": "rejected", "reason": "..."}
+
+Artifacts per replica (in ``serve_dir``):
+
+* ``serve_log.jsonl`` — ``event: "serve"`` records: admit / respond /
+  reject per request id, ``weight_swap`` (step, digest, swap_ms),
+  follower skip events. What the serving invariants replay.
+* ``train_log.jsonl`` — ``event: "heartbeat"`` records whose ``step``
+  is the terminal-outcome count: the liveness/progress signal that
+  makes the EXISTING supervisor machinery (poll, stall detection,
+  measured boot, MTTR) work unchanged for serving payloads.
+* ``serve.json`` — the bound endpoint (host, port, pid), written once
+  the replica is actually ready to serve; the client shim discovers
+  replicas by these.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import threading
+import time
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..core.config import ExperimentConfig, MeshConfig, ServeConfig
+from ..core.log import JsonlSink, get_logger
+from ..core.mesh import Topology, make_topology
+from ..models.registry import get_model
+from ..parallel.api import init_train_state, state_partition_specs
+from ..train import checkpoint as ckpt
+
+logger = get_logger("serve")
+
+_MAX_REQUEST_BYTES = 4 << 20  # a request is one image/sequence, not a shard
+
+
+# The first-checkpoint config bootstrap lives at the checkpoint layer
+# (train/checkpoint.py, next to the CheckpointFollower) — re-exported
+# here because the serving CLI reads it off this module.
+wait_for_run_config = ckpt.wait_for_run_config
+
+
+class _Pending:
+    """One admitted request waiting in the batch queue."""
+
+    __slots__ = ("req_id", "inputs", "conn", "admitted_at", "deadline_at")
+
+    def __init__(self, req_id, inputs, conn, admitted_at, deadline_at):
+        self.req_id = req_id
+        self.inputs = inputs
+        self.conn = conn
+        self.admitted_at = admitted_at
+        self.deadline_at = deadline_at
+
+
+class ServingReplica:
+    """Load the latest digest-verified checkpoint and serve it; keep
+    following publishes and hot-swap without dropping in-flight work."""
+
+    def __init__(self, train_dir: str | Path, serve_dir: str | Path = ".",
+                 scfg: ServeConfig | None = None,
+                 cfg: ExperimentConfig | None = None,
+                 topo: Topology | None = None):
+        self.train_dir = Path(train_dir)
+        self.serve_dir = Path(serve_dir)
+        self.serve_dir.mkdir(parents=True, exist_ok=True)
+        if cfg is None:
+            cfg = wait_for_run_config(self.train_dir)
+        self.cfg = cfg
+        self.scfg = scfg or cfg.serve
+        if topo is not None:
+            self.topo = topo
+        else:
+            # Lean 1-device mesh, like the evaluator's --single_device
+            # mode: serving shares a host with trainers and must not
+            # force an N-device backend or join any collective. Same
+            # refusal: pipeline-stacked layouts restore differently.
+            if cfg.mesh.pipeline_parallelism > 1:
+                raise ValueError(
+                    "serving cannot restore pipeline-stacked parameter "
+                    "layouts; serve from a non-pipeline checkpoint")
+            self.topo = make_topology(MeshConfig(num_replicas=1),
+                                      devices=jax.devices()[:1])
+        self.model = get_model(cfg.model)
+        self.template = init_train_state(self.model, cfg, self.topo)
+        self._param_specs = state_partition_specs(
+            self.model, cfg, self.topo).params
+        self.follower = ckpt.CheckpointFollower(self.train_dir)
+
+        model = self.model
+
+        def predict(params, x):
+            logits = model.apply(params, x, train=False)
+            return model.predictions(logits)
+
+        # one jit; each bucket shape compiles once on first use
+        self._predict = jax.jit(predict)
+
+        # current weights (batcher-owned) + double buffer staged by the
+        # follower thread, flipped at a batch boundary
+        self._params = None
+        self.model_step = -1
+        self.model_digest: str | None = None
+        self._staged: tuple | None = None
+        self._staged_lock = threading.Lock()
+
+        self._queue: queue.Queue[_Pending] = queue.Queue(
+            maxsize=max(1, self.scfg.queue_depth))
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._conn_threads: set[threading.Thread] = set()
+        self._conn_lock = threading.Lock()
+        self._sock: socket.socket | None = None
+        self.bound_port: int | None = None
+
+        self._journal_lock = threading.Lock()
+        self._journal_closed = False
+        self._serve_log = JsonlSink(self.serve_dir / "serve_log.jsonl")
+        self._heartbeat = JsonlSink(self.serve_dir / "train_log.jsonl")
+        self._terminals = 0          # responses + rejects ever produced
+        self._last_heartbeat = -1
+        self.swaps = 0
+
+    # -- journal ------------------------------------------------------
+
+    def _journal(self, record: dict) -> None:
+        with self._journal_lock:
+            if self._journal_closed:
+                return  # a straggler conn thread racing stop()
+            self._serve_log.write({"event": "serve",
+                                   "time": time.time(), **record})
+
+    def _terminal(self, action: str, req_id, **fields) -> None:
+        """Journal one terminal outcome (respond/reject) and bump the
+        heartbeat counter — every admitted request must produce exactly
+        one of these."""
+        self._journal({"action": action, "id": req_id, **fields})
+        with self._journal_lock:
+            self._terminals += 1
+
+    def _maybe_heartbeat(self) -> None:
+        with self._journal_lock:
+            n = self._terminals
+            if n == self._last_heartbeat or self._journal_closed:
+                return
+            self._last_heartbeat = n
+            self._heartbeat.write({"event": "heartbeat", "step": n,
+                                   "time": time.time()})
+
+    # -- weights ------------------------------------------------------
+
+    def _read_weights(self, ptr_step: int):
+        """The follower's ``read``: digest-verified restore with
+        fallback-to-previous-loadable-step — a torn/corrupt newest
+        publish is skipped (journaled), never served. Returns a staged
+        swap, or a no-swap marker when the fallback landed on (or
+        behind) what we already serve."""
+        t0 = time.time()
+        restored = ckpt.restore_checkpoint(
+            self.train_dir, self.template, None,
+            on_event=lambda rec: self._journal(
+                {"action": "follow_" + rec.get("action", "?"),
+                 **{k: v for k, v in rec.items()
+                    if k not in ("layer", "action")}}))
+        if restored is None:
+            return None
+        state, _, at_step = restored
+        if at_step <= self.model_step:
+            # the newest publish was unusable and the fallback landed
+            # on weights we already serve: consume the pointer step so
+            # the follower stops re-reading the torn artifact
+            return ("noswap", at_step)
+        params = self.topo.device_put_state(state.params, self._param_specs)
+        digest = ckpt.artifact_digest(self.train_dir, at_step)
+        return ("swap", params, at_step, digest, t0)
+
+    def _load_initial(self, timeout_s: float = 600.0) -> None:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline and not self._stop.is_set():
+            got = self.follower.poll(self._read_weights)
+            if got is not None and got[0] == "swap":
+                _, params, step, digest, t0 = got
+                self._params = params
+                self.model_step = step
+                self.model_digest = digest
+                self._journal({"action": "weight_swap", "step": step,
+                               "from_step": -1, "digest": digest,
+                               "swap_ms": round((time.time() - t0) * 1e3, 3),
+                               "initial": True})
+                self.swaps += 1
+                return
+            time.sleep(min(1.0, self.scfg.poll_secs))
+        raise TimeoutError(
+            f"no loadable checkpoint in {self.train_dir} within "
+            f"{timeout_s:.0f}s")
+
+    def _follow_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                got = self.follower.poll(self._read_weights)
+            except Exception as e:  # the service must outlive any read
+                logger.warning("checkpoint follow failed (%s: %s)",
+                               type(e).__name__, e)
+                got = None
+            if got is not None and got[0] == "swap":
+                with self._staged_lock:
+                    self._staged = got[1:]
+            self._stop.wait(self.scfg.poll_secs)
+
+    def _maybe_swap(self) -> None:
+        """Batch-boundary flip: the in-flight batch already drained on
+        the old weights; installing the staged buffer is one reference
+        assignment. Journals step + digest + swap latency."""
+        with self._staged_lock:
+            staged, self._staged = self._staged, None
+        if staged is None:
+            return
+        params, step, digest, t0 = staged
+        if step <= self.model_step:
+            return  # monotone: never swap backwards
+        prev = self.model_step
+        self._params = params
+        self.model_step = step
+        self.model_digest = digest
+        self.swaps += 1
+        self._journal({"action": "weight_swap", "step": step,
+                       "from_step": prev, "digest": digest,
+                       "swap_ms": round((time.time() - t0) * 1e3, 3)})
+
+    # -- socket front door --------------------------------------------
+
+    def _respond(self, conn, payload: dict) -> bool:
+        try:
+            conn.sendall((json.dumps(payload) + "\n").encode())
+            return True
+        except OSError:
+            return False  # client went away; the outcome is journaled
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _reject(self, conn, req_id, reason: str, admitted: bool) -> None:
+        self._terminal("reject", req_id, reason=reason, admitted=admitted)
+        self._respond(conn, {"id": req_id, "status": "rejected",
+                             "reason": reason,
+                             "model_step": self.model_step})
+
+    def _meta(self) -> dict:
+        return {"status": "ok", "meta": True,
+                "model": self.cfg.model.name,
+                "input_shape": list(self.model.input_shape),
+                "input_dtype": str(np.dtype(self.model.input_dtype)),
+                "model_step": self.model_step,
+                "max_batch": self.scfg.max_batch}
+
+    def _handle_conn(self, conn) -> None:
+        """Read one request; admit it (or shed typed). Runs on a
+        per-connection thread so a slow client can't stall admission."""
+        req_id = None
+        try:
+            conn.settimeout(5.0)
+            buf = b""
+            while b"\n" not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                if len(buf) > _MAX_REQUEST_BYTES:
+                    self._reject(conn, None, "bad_request", admitted=False)
+                    return
+            try:
+                req = json.loads(buf.decode())
+                if not isinstance(req, dict):
+                    raise ValueError("request must be a JSON object")
+            except (ValueError, UnicodeDecodeError):
+                self._reject(conn, None, "bad_request", admitted=False)
+                return
+            if req.get("meta"):
+                self._respond(conn, self._meta())
+                return
+            req_id = req.get("id")
+            if self._stop.is_set():
+                self._reject(conn, req_id, "shutting_down", admitted=False)
+                return
+            try:
+                inputs = np.asarray(req["inputs"],
+                                    dtype=np.dtype(self.model.input_dtype))
+            except (KeyError, ValueError, TypeError):
+                self._reject(conn, req_id, "bad_request", admitted=False)
+                return
+            if tuple(inputs.shape) != tuple(self.model.input_shape):
+                self._reject(conn, req_id, "bad_request", admitted=False)
+                return
+            now = time.time()
+            deadline_ms = req.get("deadline_ms",
+                                  self.scfg.default_deadline_ms)
+            item = _Pending(req_id, inputs, conn, now,
+                            now + float(deadline_ms) / 1e3)
+            try:
+                # admission control: a full queue sheds IMMEDIATELY
+                # with a typed reject — bounded queue, bounded latency,
+                # never silent starvation
+                self._queue.put_nowait(item)
+            except queue.Full:
+                self._reject(conn, req_id, "overloaded", admitted=False)
+                return
+            self._journal({"action": "admit", "id": req_id,
+                           "deadline_ms": float(deadline_ms)})
+        except OSError:
+            # the socket died before we could even reject; if nothing
+            # was admitted there is no outcome to owe
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _accept_loop(self) -> None:
+        assert self._sock is not None
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._handle_conn, args=(conn,),
+                                 daemon=True)
+            with self._conn_lock:
+                self._conn_threads = {x for x in self._conn_threads
+                                      if x.is_alive()}
+                self._conn_threads.add(t)
+            t.start()
+
+    # -- the batcher --------------------------------------------------
+
+    @staticmethod
+    def _bucket(n: int, max_batch: int) -> int:
+        b = 1
+        while b < n and b < max_batch:
+            b *= 2
+        return min(b, max_batch)
+
+    def _gather(self) -> list[_Pending]:
+        """Pop up to ``max_batch`` requests: block briefly for the
+        first, then drain whatever arrived within the batch window."""
+        try:
+            first = self._queue.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        items = [first]
+        window = self.scfg.batch_window_ms / 1e3
+        deadline = time.monotonic() + window
+        while len(items) < self.scfg.max_batch:
+            remaining = deadline - time.monotonic()
+            try:
+                items.append(self._queue.get(
+                    timeout=max(0.0, remaining)))
+            except queue.Empty:
+                break
+        return items
+
+    def _run_batch(self, items: list[_Pending]) -> None:
+        now = time.time()
+        live: list[_Pending] = []
+        for it in items:
+            if now >= it.deadline_at:
+                self._reject(it.conn, it.req_id, "deadline_exceeded",
+                             admitted=True)
+            else:
+                live.append(it)
+        if not live:
+            return
+        bucket = self._bucket(len(live), self.scfg.max_batch)
+        dtype = np.dtype(self.model.input_dtype)
+        x = np.zeros((bucket, *self.model.input_shape), dtype)
+        for i, it in enumerate(live):
+            x[i] = it.inputs
+        step, digest = self.model_step, self.model_digest
+        probs = np.asarray(jax.device_get(self._predict(self._params, x)))
+        for i, it in enumerate(live):
+            p = probs[i]
+            self._terminal(
+                "respond", it.req_id, model_step=step,
+                batch=len(live), bucket=bucket,
+                latency_ms=round((time.time() - it.admitted_at) * 1e3, 3))
+            self._respond(it.conn, {
+                "id": it.req_id, "status": "ok", "model_step": step,
+                "model_digest": digest,
+                "prediction": int(np.argmax(p)),
+                "probs": [round(float(v), 6) for v in p]})
+
+    def _batch_loop(self) -> None:
+        while not self._stop.is_set():
+            self._maybe_swap()
+            items = self._gather()
+            if items:
+                self._run_batch(items)
+            self._maybe_heartbeat()
+        # graceful drain: everything still queued gets a TYPED reject —
+        # a stopping replica sheds, it never silently drops
+        while True:
+            try:
+                it = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            self._reject(it.conn, it.req_id, "shutting_down", admitted=True)
+        self._maybe_heartbeat()
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> None:
+        """Load initial weights, bind, publish ``serve.json``, and
+        start the follower/accept/batcher threads. Idempotent-unsafe:
+        one start per replica object."""
+        endpoint_path = self.serve_dir / "serve.json"
+        endpoint_path.unlink(missing_ok=True)  # stale incarnation
+        self._load_initial()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.scfg.host, self.scfg.port))
+        self._sock.listen(128)
+        self.bound_port = self._sock.getsockname()[1]
+        for target in (self._follow_loop, self._accept_loop,
+                       self._batch_loop):
+            t = threading.Thread(target=target, daemon=True,
+                                 name=f"serve-{target.__name__}")
+            t.start()
+            self._threads.append(t)
+        import os
+        tmp = endpoint_path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(
+            {"host": self.scfg.host, "port": self.bound_port,
+             "pid": os.getpid(), "model_step": self.model_step,
+             "started_at": time.time()}))
+        tmp.replace(endpoint_path)
+        self._journal({"action": "serve_start", "port": self.bound_port,
+                       "model_step": self.model_step,
+                       "queue_depth": self.scfg.queue_depth,
+                       "max_batch": self.scfg.max_batch})
+        self._maybe_heartbeat()
+        logger.info("serving %s step=%d on %s:%d", self.cfg.model.name,
+                    self.model_step, self.scfg.host, self.bound_port)
+
+    def request_stop(self) -> None:
+        self._stop.set()
+
+    def stop(self) -> None:
+        """Stop accepting, drain the queue with typed rejects, close."""
+        self.request_stop()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=30)
+        # close the admit-vs-drain race: a connection handler that
+        # passed its stop check just before request_stop() may enqueue
+        # AFTER the batcher's final drain — join the (short-lived)
+        # handler threads, then drain once more so every admitted
+        # request still gets its typed terminal outcome
+        with self._conn_lock:
+            stragglers = list(self._conn_threads)
+        for t in stragglers:
+            t.join(timeout=10)
+        while True:
+            try:
+                it = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            self._reject(it.conn, it.req_id, "shutting_down",
+                         admitted=True)
+        self._journal({"action": "serve_stop",
+                       "terminals": self._terminals,
+                       "model_step": self.model_step, "swaps": self.swaps})
+        with self._journal_lock:
+            self._journal_closed = True
+            self._serve_log.close()
+            self._heartbeat.close()
+
+    def serve_forever(self, install_signal_handlers: bool = True) -> None:
+        """The process entry: start, park until SIGTERM/SIGINT (the
+        graceful drain the supervisor's ``stop_all`` relies on), stop."""
+        if install_signal_handlers:
+            import signal
+
+            def handler(signum, frame):
+                logger.warning("received signal %s — draining and "
+                               "stopping", signum)
+                self.request_stop()
+
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    signal.signal(sig, handler)
+                except (ValueError, OSError):
+                    pass
+        self.start()
+        try:
+            while not self._stop.is_set():
+                self._stop.wait(0.5)
+        finally:
+            self.stop()

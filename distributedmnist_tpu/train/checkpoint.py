@@ -649,6 +649,106 @@ def read_checkpoint_world(train_dir: str | Path,
     return (world if isinstance(world, dict) else None), step
 
 
+class CheckpointFollower:
+    """The newest-checkpoint hot-follow loop shared by the long-running
+    checkpoint consumers (``evalsvc`` evaluator, ``servesvc`` serving
+    replica): atomic pointer read, step-advanced check, and
+    skip-and-retry on an unreadable/torn/corrupt artifact.
+
+    One poll: :meth:`poll(read)` reads the pointer; when the newest
+    step has advanced past the last one successfully consumed, it calls
+    ``read(step)`` and returns its result. ``read`` raising
+    ``OSError`` / ``ValueError`` (which covers
+    :class:`CheckpointCorruptError`) / ``KeyError`` — the trainer's GC
+    unlinking the step between the pointer read and the restore, a
+    shared fs serving a torn file, a failed digest — is a SKIP, not a
+    crash: the failure is remembered per step (``last_error``), None is
+    returned, and the next poll retries (or moves on to a newer
+    publish). ``read`` returning None (e.g. nothing restorable) leaves
+    the cursor unmoved the same way. A long-running service built on
+    this never dies to a torn publish."""
+
+    def __init__(self, train_dir: str | Path,
+                 on_event: Callable[[dict], None] | None = None):
+        self.train_dir = Path(train_dir)
+        self.last_step = -1          # last step successfully consumed
+        self.last_error: tuple[int, str] | None = None  # (step, error)
+        self.skips = 0               # torn/corrupt publishes survived
+        self._on_event = on_event
+
+    def newest_step(self) -> int | None:
+        """The pointer's current step (None before the first publish)
+        — exposed so callers can log 'nothing yet' distinctly."""
+        return latest_checkpoint_step(self.train_dir)
+
+    def poll(self, read: Callable[[int], Any]) -> Any | None:
+        """One follow tick; returns ``read(step)``'s result for a newly
+        advanced step, else None (nothing new, or the read failed and
+        will be retried)."""
+        step = self.newest_step()
+        if step is None or step == self.last_step:
+            return None
+        try:
+            out = read(step)
+        except (OSError, ValueError, KeyError) as e:
+            self.skips += 1
+            self.last_error = (step, f"{type(e).__name__}: {e}")
+            logger.warning("checkpoint step=%s unreadable (%s); "
+                           "skip-and-retry", step, e)
+            if self._on_event is not None:
+                self._on_event({"layer": "checkpoint",
+                                "action": "follow_skip", "step": step,
+                                "error": self.last_error[1]})
+            return None
+        if out is None:
+            return None
+        self.last_step = step
+        return out
+
+
+def wait_for_run_config(train_dir: str | Path,
+                        timeout_s: float = 600.0):
+    """Block until the first checkpoint publishes, then adopt its
+    saved config — the bootstrap both long-running checkpoint
+    consumers (the evaluator and the serving replica) start from, so
+    there is no trainer/consumer graph skew. Reads only the JSON
+    ``extra`` payload (no state template), so any model/optimizer
+    shape works. Returns an ``ExperimentConfig``."""
+    from ..core.config import ExperimentConfig
+    train_dir = Path(train_dir)
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        try:
+            out = read_checkpoint_extra(train_dir)
+        except (OSError, ValueError, KeyError) as e:
+            # mid-replace read on a shared fs / torn file — the caller
+            # is a long-running service, retry on the next poll
+            logger.warning("checkpoint read failed (%s); retrying", e)
+            out = None
+        if out is not None:
+            extra, _ = out
+            if "config" in extra:
+                return ExperimentConfig.from_dict(extra["config"])
+            logger.warning("checkpoint has no saved config; using defaults")
+            return ExperimentConfig()
+        time.sleep(1.0)
+    raise TimeoutError(
+        f"no checkpoint appeared in {train_dir} within {timeout_s:.0f}s")
+
+
+def artifact_digest(train_dir: str | Path, step: int) -> str | None:
+    """The recorded sha256 of a step's single-file artifact (its digest
+    sidecar) — what a serving replica journals as the identity of the
+    weights it swapped in. None when no sidecar exists (pre-checksum
+    layout) or the artifact is sharded (manifest layout)."""
+    train_dir = Path(train_dir)
+    dpath = _digest_path(_ckpt_path(train_dir, step))
+    try:
+        return dpath.read_text().strip() or None
+    except OSError:
+        return None
+
+
 def _check_world(extra: Any, step: int, expect_world: dict | None) -> None:
     """Strict-world gate: callers that CANNOT reshard (no
     restore_for_topology in their path) pass the world they require;

@@ -723,6 +723,11 @@ class Trainer:
                     jax.profiler.stop_trace()
                     tracing_step = None
 
+                if cfg.step_pace_ms > 0:
+                    # deliberate wall throttle (serving-chaos publisher
+                    # pacing) — after the step, before any cadence work
+                    time.sleep(cfg.step_pace_ms / 1e3)
+
                 if step % log_every == 0:
                     flush(time.time())
 

@@ -186,6 +186,35 @@ def _save_two(tmp_path):
 
 
 @pytest.mark.tier1
+def test_checkpoint_follower_skip_and_retry(tmp_path):
+    """The shared hot-follow loop (evalsvc + servesvc): pointer read,
+    step-advanced check, and skip-and-retry when the read raises —
+    a torn publish costs polls, never the service."""
+    state, _, _ = _state_and_model()
+    f = ckpt.CheckpointFollower(tmp_path)
+    assert f.newest_step() is None
+    assert f.poll(lambda s: 1 / 0) is None  # nothing published: no read
+    ckpt.save_checkpoint(tmp_path, state, 5)
+
+    def bad(step):
+        raise ckpt.CheckpointCorruptError(f"torn step {step}")
+
+    events = []
+    f = ckpt.CheckpointFollower(tmp_path, on_event=events.append)
+    assert f.poll(bad) is None
+    assert f.last_step == -1 and f.skips == 1
+    assert f.last_error == (5, "CheckpointCorruptError: torn step 5")
+    assert events[0]["action"] == "follow_skip"
+    assert f.poll(bad) is None and f.skips == 2  # retried, still skipped
+    got = f.poll(lambda step: ("consumed", step))
+    assert got == ("consumed", 5) and f.last_step == 5
+    # unchanged step: the read is NOT re-run
+    assert f.poll(lambda s: 1 / 0) is None
+    ckpt.save_checkpoint(tmp_path, state, 9)
+    assert f.poll(lambda step: step) == 9  # advanced: consumed
+
+
+@pytest.mark.tier1
 def test_truncated_latest_falls_back_to_previous_step(tmp_path):
     """A torn write of the newest checkpoint (truncated msgpack) must
     not wedge the resume: restore lands on the previous loadable step

@@ -83,6 +83,38 @@ def test_evaluator_single_device_mode(tmp_train_dir, synthetic_datasets,
                   datasets=synthetic_datasets, single_device=True)
 
 
+def test_evaluator_skips_corrupt_checkpoint_and_retries(
+        tmp_train_dir, synthetic_datasets, tmp_path):
+    """The satellite regression this path never had: a corrupt/torn
+    newest checkpoint makes the evaluator SKIP-AND-RETRY (via the
+    shared train/checkpoint.py CheckpointFollower), not crash — and
+    once a good publish lands, it evaluates that. Pins the contract
+    that CheckpointCorruptError flows into the follower's ValueError
+    skip path instead of killing the long-running service."""
+    from pathlib import Path
+
+    from distributedmnist_tpu.core.config import EvalConfig
+    from distributedmnist_tpu.evalsvc import Evaluator
+    cfg = _train(tmp_train_dir, synthetic_datasets, steps=20)
+    newest = Path(tmp_train_dir) / "ckpt-00000020.msgpack"
+    good_bytes = newest.read_bytes()
+    # tear the newest artifact; its digest sidecar stays — the read
+    # fails verification (CheckpointCorruptError, a ValueError)
+    newest.write_bytes(good_bytes[: len(good_bytes) // 2])
+    ev = Evaluator(tmp_train_dir, EvalConfig(eval_dir=str(tmp_path / "e")),
+                   cfg=cfg, datasets=synthetic_datasets)
+    assert ev.poll_once() is None          # skipped, no crash
+    assert ev.last_step_evaluated == -1    # nothing consumed
+    assert ev.follower.skips == 1
+    assert ev.follower.last_error[0] == 20
+    assert ev.poll_once() is None          # retried, still skipped
+    assert ev.follower.skips == 2
+    newest.write_bytes(good_bytes)         # the re-publish lands
+    out = ev.poll_once()
+    assert out is not None and out["step"] == 20
+    assert ev.last_step_evaluated == 20
+
+
 def test_evaluator_adopts_checkpoint_config(tmp_train_dir, synthetic_datasets, tmp_path):
     """The evaluator rebuilds the exact trainer config from the
     checkpoint itself — no trainer/evaluator graph skew."""

@@ -30,6 +30,32 @@ def test_all_registered_models_buildable():
         assert jnp.all(jnp.isfinite(logits))
 
 
+def test_every_model_exports_predictions():
+    """The serving tier's model-agnostic contract: EVERY registered
+    family carries a ``predictions`` export producing a per-example
+    probability distribution (softmax class probs for classifiers,
+    next-token distribution for the LM) — the same registry-driven
+    genericity the trainer has."""
+    for name in available():
+        cfg = ModelConfig(name=name, compute_dtype="float32",
+                          num_channels=3 if name == "resnet20" else 1,
+                          image_size=32 if name == "resnet20" else 28,
+                          seq_len=32, model_dim=32, num_heads=2, num_layers=1)
+        model = get_model(cfg)
+        assert callable(model.predictions), name
+        params = model.init(jax.random.PRNGKey(0))
+        x = jnp.zeros((2,) + model.input_shape, model.input_dtype)
+        probs = model.predictions(model.apply(params, x, train=False))
+        # one distribution per example, regardless of family
+        assert probs.ndim == 2 and probs.shape[0] == 2, (name, probs.shape)
+        expected_classes = (cfg.vocab_size if name == "transformer"
+                            else cfg.num_classes)
+        assert probs.shape[1] == expected_classes, name
+        np.testing.assert_allclose(np.asarray(probs).sum(axis=-1),
+                                   np.ones(2), rtol=1e-5)
+        assert np.all(np.asarray(probs) >= 0), name
+
+
 def test_cnn_param_shapes_and_init_constants():
     """Parity with reference init (src/mnist.py:81-101): conv1 bias 0,
     conv2/fc biases 0.1, truncated-normal weights with stddev 0.1."""
