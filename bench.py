@@ -163,18 +163,30 @@ def _scan_chunks(step_fn, state, gbatch, chunk_len: int, n_chunks: int):
 
 
 def _build(cfg_dict: dict, topo=None):
-    from distributedmnist_tpu.core.config import ExperimentConfig
+    from distributedmnist_tpu.core.config import (ExperimentConfig,
+                                                  effective_model_config)
     from distributedmnist_tpu.core.mesh import make_topology
     from distributedmnist_tpu.models.registry import get_model
     from distributedmnist_tpu.parallel.api import (build_train_step,
                                                    init_train_state)
-    from distributedmnist_tpu.train.lr_schedule import constant
+    from distributedmnist_tpu.train.lr_schedule import (
+        constant, warmup_polynomial_decay)
 
     cfg = ExperimentConfig.from_dict(cfg_dict)
     topo = topo or make_topology()
-    model = get_model(cfg.model)
+    # same resolutions the Trainer applies: precision.compute_dtype
+    # through the shared helper, and the configured schedule — a case
+    # whose recipe names warmup/poly must actually MEASURE it
+    model = get_model(effective_model_config(cfg))
+    if cfg.optim.schedule == "polynomial":
+        schedule = warmup_polynomial_decay(
+            cfg.optim.initial_learning_rate, cfg.optim.warmup_steps,
+            cfg.optim.decay_total_steps or cfg.train.max_steps,
+            cfg.optim.end_learning_rate, cfg.optim.poly_power)
+    else:
+        schedule = constant(8e-4)  # throughput cases: fixed, decay-free
     state = topo.device_put_replicated(init_train_state(model, cfg))
-    step_fn = build_train_step(model, cfg, topo, constant(8e-4))
+    step_fn = build_train_step(model, cfg, topo, schedule)
     return cfg, topo, model, state, step_fn
 
 
@@ -380,6 +392,7 @@ def bench_mode_overhead() -> list[dict]:
     chunk_len, n_chunks, n_repeats = 20, 2, 3
 
     timers: dict[str, _ChunkTimer] = {}
+    programs: dict[str, dict] = {}
     for name, sync_cfg in modes.items():
         cfg, topo, model, state, step_fn = _build({
             "data": {"dataset": "synthetic", "batch_size": batch},
@@ -387,6 +400,25 @@ def bench_mode_overhead() -> list[dict]:
             "sync": sync_cfg,
         })
         gbatch = topo.device_put_batch(host_batch)
+        try:
+            # structural evidence BEFORE the timer donates the state:
+            # the lowered per-step program, hashed. The per-worker CDF
+            # instrumentation (the [n] step-time vector + contribution
+            # flags) is emitted in EVERY mode including sync, and cdf's
+            # full-barrier flag is the same constant as sync's — so the
+            # cdf program is byte-identical StableHLO to sync's, and
+            # any measured "cdf overhead" is capture noise by
+            # construction (the r05 11.82% reading; gated since by the
+            # interleaved-repeat median below).
+            import hashlib
+            txt = step_fn.jitted.lower(state, gbatch,
+                                       topo.zeros_measured()).as_text()
+            programs[name] = {
+                "stablehlo_lines": txt.count("\n"),
+                "stablehlo_sha256": hashlib.sha256(
+                    txt.encode()).hexdigest()[:16]}
+        except Exception as e:  # evidence is best-effort, never fatal
+            programs[name] = {"error": f"{type(e).__name__}: {e}"}
         timers[name] = _ChunkTimer(step_fn, state, gbatch, chunk_len)
 
     rates: dict[str, list[float]] = {name: [] for name in modes}
@@ -401,6 +433,8 @@ def bench_mode_overhead() -> list[dict]:
         by_repeat = [round((s - m) / s * 100, 2)
                      for s, m in zip(rates["sync"], rates[mode])]
         overhead = (med["sync"] - med[mode]) / med["sync"]
+        same_program = (programs.get(mode) == programs.get("sync")
+                        and "error" not in programs.get(mode, {"error": 1}))
         records.append({
             "metric": f"{mode}_mode_overhead_vs_sync",
             "value": round(overhead * 100, 2), "unit": "percent",
@@ -410,6 +444,12 @@ def bench_mode_overhead() -> list[dict]:
                 "overhead_pct_by_repeat": by_repeat,
                 "sync_img_per_sec_median": round(med["sync"], 1),
                 f"{mode}_img_per_sec_median": round(med[mode], 1),
+                # compiled-program identity: when this mode's lowered
+                # StableHLO hashes equal to sync's, the instrumentation
+                # adds literally zero ops and nonzero "overhead"
+                # readings are wall-clock capture noise
+                "program": programs.get(mode),
+                "program_identical_to_sync": same_program,
                 "img_per_sec_by_repeat": {
                     "sync": [round(r, 1) for r in rates["sync"]],
                     mode: [round(r, 1) for r in rates[mode]]}}})
@@ -663,6 +703,126 @@ def bench_weight_update_sharding() -> dict:
             "updates_per_sec_by_repeat": {
                 k: [round(r, 2) for r in v] for k, v in rates.items()},
             **_env_stamp()}}
+
+
+def bench_weak_scaling() -> dict:
+    """Weak-scaling efficiency of the large-batch playbook (ROADMAP
+    item 4, arXiv:1909.09756): images/sec at 1→2→4→8 devices with a
+    CONSTANT per-device batch, flagship CNN under the full recipe —
+    LAMB + linear-warmup/polynomial-decay schedule + bf16 compute with
+    fp32 master weights. Each device count runs on a sub-mesh of the
+    same visible devices (the forced mesh in CI), timed with the same
+    on-device scan discipline as the headline.
+
+    Gate (at 8 devices), backend-dependent because the claim is about
+    OUR step program, not the host:
+
+      * accelerators — the honest weak-scaling floor: img/s at n ≥
+        0.6 × n × img/s at 1 (DP allreduce efficiency).
+      * CPU backend — n virtual devices on a few cores SERIALIZE at
+        every collective rendezvous (capacity ~min(n, cores) is still
+        optimistic: measured 24 img/s at n=2 on a 2-core host vs 25 at
+        n=1), so the gated claim is that multiplying virtual devices
+        does not CRATER total throughput: img/s at 8 ≥ 0.5 × img/s at
+        1 (measured 0.72× on this 2-core box). A step program whose
+        per-device or collective cost grew superlinearly would fail
+        it; a slow runner alone cannot.
+
+    Per-device-count throughput and the raw efficiency curve land in
+    the artifact either way."""
+    import os
+
+    from distributedmnist_tpu.core.config import MeshConfig
+    from distributedmnist_tpu.core.mesh import make_topology
+    from distributedmnist_tpu.data.datasets import make_synthetic
+
+    devs = jax.devices()
+    counts = [c for c in (1, 2, 4, 8) if c <= len(devs)]
+    cpu = jax.default_backend() == "cpu"
+    # CPU arms stay CI-affordable: the ratio gate needs matched
+    # per-device work across device counts, not a big absolute batch
+    per_dev = 64 if cpu else 2048
+    chunk_len, n_chunks = (6, 2) if cpu else (50, 4)
+    # bf16 is the MXU's native mode but SOFTWARE-emulated in CPU convs
+    # (measured ~40× slower at this shape) — the CPU artifact measures
+    # the scaling shape in f32 compute, accelerators run the full-bf16
+    # recipe; the fp32-master machinery (bf16 param view, f32 update)
+    # is exercised either way
+    compute = "float32" if cpu else "bfloat16"
+    recipe = {
+        "optim": {"name": "lamb", "initial_learning_rate": 4e-3,
+                  "schedule": "polynomial", "warmup_steps": 20,
+                  "decay_total_steps": 2000, "weight_decay": 1e-4},
+        "precision": {"param_dtype": "bfloat16", "master_weights": True,
+                      "compute_dtype": compute},
+    }
+
+    ds = make_synthetic(num_train=per_dev * max(counts), num_test=64)
+    rates: dict[int, float] = {}
+    compile_s: dict[int, float] = {}
+    for n in counts:
+        topo = make_topology(MeshConfig(num_replicas=n), devices=devs[:n])
+        batch = per_dev * n
+        cfg, topo, model, state, step_fn = _build({
+            "data": {"dataset": "synthetic", "batch_size": batch},
+            "model": {"compute_dtype": compute},
+            "sync": {"mode": "sync"},
+            **recipe,
+        }, topo)
+        gbatch = topo.device_put_batch(
+            {"image": ds.train.images[:batch],
+             "label": ds.train.labels[:batch]})
+        times, comp, _ = _scan_chunks(step_fn, state, gbatch,
+                                      chunk_len, n_chunks)
+        rates[n] = chunk_len * n_chunks * batch / sum(times)
+        compile_s[n] = round(comp, 2)
+        print(f"# weak_scaling n={n} batch={batch} "
+              f"{rates[n]:.0f} img/s", file=sys.stderr)
+
+    n_max = counts[-1]
+    eff_curve = {n: round(rates[n] / (n * rates[1]), 3) for n in counts}
+    cores = os.cpu_count() or 1
+    if cpu:
+        floor = 0.5
+        gate_metric = rates[n_max] / rates[1]  # no-crater ratio
+        gate_desc = (f"cpu backend: img/s at {n_max} virtual devices ≥ "
+                     f"{floor}× img/s at 1 (collectives serialize on "
+                     f"{cores} core(s); the gate catches superlinear "
+                     "per-device/collective cost, not host speed)")
+    else:
+        floor = 0.6
+        gate_metric = eff_curve[n_max]  # true weak-scaling efficiency
+        gate_desc = (f"accelerator: img/s at {n_max} devices ≥ {floor}× "
+                     f"{n_max}× img/s at 1 (DP allreduce efficiency)")
+    gated = n_max >= 8
+    passes = bool(gate_metric >= floor) if gated else None
+    record = {
+        "metric": "weak_scaling_efficiency",
+        "value": round(eff_curve[n_max], 3),
+        "unit": f"x (img/s at {n_max} dev ÷ {n_max}× img/s at 1 dev)",
+        "passes_gate": passes,
+        "detail": {
+            "gate": gate_desc,
+            "gate_metric": round(gate_metric, 3),
+            "recipe": recipe,
+            "per_device_batch": per_dev,
+            "images_per_sec_by_devices": {str(n): round(r, 1)
+                                          for n, r in rates.items()},
+            "efficiency_by_devices": {str(n): e
+                                      for n, e in eff_curve.items()},
+            "throughput_ratio_nmax_vs_1": round(rates[n_max] / rates[1], 3),
+            "host_cpu_count": cores,
+            "compile_s_by_devices": {str(n): c
+                                     for n, c in compile_s.items()},
+            "compile_s": compile_s[n_max],
+            **_env_stamp()},
+    }
+    if not gated:
+        record["skipped_gate"] = (
+            f"only {n_max} device(s) visible — the efficiency floor "
+            "gates at 8 (force a mesh, e.g. XLA_FLAGS=--xla_force_"
+            "host_platform_device_count=8)")
+    return record
 
 
 def bench_restart_latency() -> dict:
@@ -1131,7 +1291,8 @@ def main() -> None:
     for case in (bench_transformer_flash, bench_flash_long_context,
                  bench_mode_overhead, bench_native_loader,
                  bench_input_pipeline_overlap, bench_weight_update_sharding,
-                 bench_restart_latency, bench_serving_latency):
+                 bench_weak_scaling, bench_restart_latency,
+                 bench_serving_latency):
         if not want(case):
             continue
         try:

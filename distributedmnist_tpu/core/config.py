@@ -134,8 +134,33 @@ class ModelConfig:
 
 @dataclass(frozen=True)
 class OptimConfig:
-    """SGD + exponential staircase decay (≙ src/distributed_train.py:88-99,143-156)."""
+    """Optimizer selection + LR schedule.
 
+    The reference hardwires plain GradientDescentOptimizer with
+    exponential staircase decay (src/distributed_train.py:88-99,
+    143-156,176); ``name`` opens that into the large-batch registry
+    (train/optim.py) per "Scale MLPerf-0.6 models on Google TPU-v3
+    Pods" (arXiv:1909.09756):
+
+      * ``sgd``      — plain SGD; ``momentum > 0`` adds heavyball
+                       momentum (the historical behavior of this knob).
+      * ``momentum`` — explicit heavyball momentum-SGD.
+      * ``lars``     — layer-wise adaptive rate scaling
+                       (arXiv:1708.03888): per-leaf trust ratio
+                       ``eta·‖w‖/‖g + wd·w‖`` scales the momentum
+                       input; ``beta1`` is its momentum coefficient.
+      * ``lamb``     — layer-wise Adam (arXiv:1904.00962): Adam moments
+                       (``beta1``/``beta2``/``eps``) with the per-leaf
+                       trust ratio ``‖w‖/‖update‖``.
+
+    LARS/LAMB own their momentum term (``beta1``): combining them with
+    ``momentum != 0`` is a validated ConfigError, as is an unknown
+    ``name`` (train/optim.py ``validate``). 1-D leaves (biases, norm
+    scales) skip weight decay and trust-ratio adaptation, per both
+    papers' recipes.
+    """
+
+    name: str = "sgd"  # sgd | momentum | lars | lamb
     initial_learning_rate: float = 0.1
     num_epochs_per_decay: float = 2.0
     learning_rate_decay_factor: float = 0.999
@@ -143,6 +168,24 @@ class OptimConfig:
     # decay_steps = batches_per_epoch * num_epochs_per_decay / k where k
     # is the aggregation quorum (src/distributed_train.py:147).
     momentum: float = 0.0  # reference uses plain GradientDescentOptimizer (:176)
+    # -- trust-ratio optimizer hyperparameters (lars/lamb) -------------
+    beta1: float = 0.9       # lamb first moment / lars momentum
+    beta2: float = 0.999     # lamb second moment
+    eps: float = 1e-6        # lamb denominator floor
+    weight_decay: float = 0.0
+    trust_coefficient: float = 0.001  # lars eta
+    # -- schedule ------------------------------------------------------
+    # "exponential": the reference's staircase decay (the default path;
+    #   learning_rate_decay_factor == 1.0 degrades to constant).
+    # "polynomial": linear warmup over warmup_steps then polynomial
+    #   decay to end_learning_rate at decay_total_steps — the MLPerf
+    #   large-batch recipe (arXiv:1909.09756 §3). decay_total_steps=0
+    #   resolves to train.max_steps at Trainer build.
+    schedule: str = "exponential"  # exponential | polynomial
+    warmup_steps: int = 0
+    decay_total_steps: int = 0
+    end_learning_rate: float = 0.0
+    poly_power: float = 2.0
 
 
 @dataclass(frozen=True)
@@ -222,6 +265,38 @@ class ParallelConfig:
 
     shard_weight_update: bool = False
     shard_min_leaf_size: int = 0
+
+
+@dataclass(frozen=True)
+class PrecisionConfig:
+    """Mixed precision as a config knob (arXiv:1909.09756 §2: bf16
+    compute with fp32 master weights is the TPU large-batch recipe).
+
+    ``param_dtype``: the dtype the forward/backward pass sees the
+    parameters in. With ``master_weights=true``, ``TrainState.params``
+    stay float32 (the master copy — what the optimizer updates, what
+    the ZeRO-1 update shards/gathers, and what checkpoints store
+    canonically) and the train step casts them to ``param_dtype`` just
+    before ``apply``; the low-precision view is derived, never
+    persistent state, so restores and digests are precision-portable.
+    With ``master_weights=false`` and a low-precision ``param_dtype``,
+    params are cast once at init and updated in that dtype — true
+    low-precision training (optimizer moments stay float32 either way;
+    gradients are accumulated and aggregated in float32).
+
+    ``compute_dtype``: overrides ``model.compute_dtype`` when set
+    (activations/matmuls); "" leaves the model section authoritative.
+
+    When to leave it all off (the defaults): float32 params + the
+    model's bf16 compute is already the MXU-native single-chip mode;
+    master weights only start paying once ``param_dtype`` drops below
+    float32 — at which point updates of tiny weights (lr·g below the
+    bf16 ulp) would silently round to no-ops without the fp32 master.
+    """
+
+    param_dtype: str = "float32"
+    compute_dtype: str = ""  # "" → model.compute_dtype
+    master_weights: bool = False
 
 
 @dataclass(frozen=True)
@@ -321,6 +396,15 @@ class TrainConfig:
     max_steps: int = 1000
     train_dir: str = "/tmp/dmt_train"
     seed: int = 0
+    # Gradient accumulation (arXiv:1909.09756 §2): each loop step pulls
+    # this many consecutive batches, microbatch-scans them inside the
+    # compiled step accumulating gradients in float32, and applies the
+    # optimizer ONCE — effective batch = data.batch_size ×
+    # grad_accum_steps, past what device memory fits in one pass.
+    # Sync/quorum/timeout masking, LR-schedule pacing and the
+    # BatchIterator cursor all see one step per application; the cursor
+    # simply advances grad_accum_steps batches per step. 1 = off.
+    grad_accum_steps: int = 1
     save_interval_steps: int = 200  # ≙ save_interval_secs=20 Supervisor autosave (:76)
     save_interval_secs: float = 0.0  # optional wall-clock cadence; 0 = step-based
     # The reference logs every step (:365-371); here metrics stay on
@@ -409,6 +493,17 @@ class EvalConfig:
     max_evals: int = 0  # 0 = unbounded
 
 
+def effective_model_config(cfg: "ExperimentConfig") -> ModelConfig:
+    """The model section with ``precision.compute_dtype`` applied when
+    set — the ONE resolution every model-building consumer (Trainer,
+    evaluator, serving replica) goes through, so the precision section
+    can't drift from the model section between tiers."""
+    if not cfg.precision.compute_dtype:
+        return cfg.model
+    return dataclasses.replace(cfg.model,
+                               compute_dtype=cfg.precision.compute_dtype)
+
+
 @dataclass(frozen=True)
 class ExperimentConfig:
     name: str = "default"
@@ -418,6 +513,7 @@ class ExperimentConfig:
     sync: SyncConfig = field(default_factory=SyncConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    precision: PrecisionConfig = field(default_factory=PrecisionConfig)
     compile: CompileConfig = field(default_factory=CompileConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     eval: EvalConfig = field(default_factory=EvalConfig)
@@ -493,6 +589,7 @@ _SECTION_TYPES = {
     ("ExperimentConfig", "sync"): SyncConfig,
     ("ExperimentConfig", "mesh"): MeshConfig,
     ("ExperimentConfig", "parallel"): ParallelConfig,
+    ("ExperimentConfig", "precision"): PrecisionConfig,
     ("ExperimentConfig", "compile"): CompileConfig,
     ("ExperimentConfig", "train"): TrainConfig,
     ("ExperimentConfig", "eval"): EvalConfig,

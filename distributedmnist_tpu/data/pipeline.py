@@ -151,6 +151,55 @@ class BatchIterator:
         self._pos = int(state["pos"])
 
 
+class GradAccumFeed:
+    """Feed adapter for gradient accumulation (train.grad_accum_steps):
+    each ``next()`` pulls ``accum`` consecutive batches from the inner
+    stream and concatenates them along dim 0 — the train step scans
+    that as microbatches and applies the optimizer once.
+
+    The inner ``BatchIterator``'s cursor math is untouched: it simply
+    advances ``accum`` batches per training step, so ``state()`` /
+    ``restore()`` (passed straight through) checkpoint the exact
+    sample-stream position in the same lockstep ``batches`` coordinate
+    the elastic-resume contract uses — a resume under a different
+    ``grad_accum_steps`` (or world size) re-derives its own grouping
+    from the same coordinate with no samples dropped or re-visited."""
+
+    def __init__(self, inner, accum: int):
+        if accum < 1:
+            raise ValueError(f"grad_accum_steps must be >= 1, got {accum}")
+        self.inner = inner
+        self.accum = accum
+        self.has_state = callable(getattr(inner, "state", None))
+        if not self.has_state:
+            # shadow the pass-through methods so feed consumers that
+            # probe callable(feed.state) (Trainer._save, the device
+            # prefetcher) see the inner stream's true statelessness
+            self.state = None      # type: ignore[assignment]
+            self.restore = None    # type: ignore[assignment]
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> dict:
+        batches = [next(self.inner) for _ in range(self.accum)]
+        if self.accum == 1:
+            return batches[0]
+        return {k: np.concatenate([b[k] for b in batches])
+                for k in batches[0]}
+
+    def state(self) -> dict:
+        return self.inner.state()
+
+    def restore(self, state: dict) -> None:
+        self.inner.restore(state)
+
+    def close(self) -> None:
+        close = getattr(self.inner, "close", None)
+        if callable(close):
+            close()
+
+
 def consumed_sample_ranges(state: dict) -> list[tuple[int, int]]:
     """The half-open global CONSUMPTION-SLOT index ranges a cursor
     state covers: global batch ``b`` assigns slots

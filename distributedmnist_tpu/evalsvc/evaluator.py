@@ -22,7 +22,8 @@ from pathlib import Path
 
 import jax
 
-from ..core.config import EvalConfig, ExperimentConfig, MeshConfig
+from ..core.config import (EvalConfig, ExperimentConfig, MeshConfig,
+                           effective_model_config)
 from ..core.log import JsonlSink, eval_line, get_logger
 from ..core.mesh import Topology, make_topology
 from ..data.datasets import Datasets, load_datasets
@@ -84,7 +85,7 @@ class Evaluator:
                                       devices=jax.devices()[:1])
         else:
             self.topo = make_topology(cfg.mesh)
-        self.model = get_model(cfg.model)
+        self.model = get_model(effective_model_config(cfg))
         self.datasets = datasets if datasets is not None else load_datasets(
             cfg.data, cfg.model.image_size, cfg.model.num_channels,
             cfg.model.num_classes, cfg.model.seq_len, cfg.model.vocab_size)

@@ -54,10 +54,18 @@ class TrainState(struct.PyTreeNode):
     ``updates_applied`` is the reference's global_step — it counts
     *applied updates* (PS applies, src/distributed_train.py:140), while
     ``step`` counts loop iterations; the two differ in interval mode.
+
+    ``momentum`` holds the optimizer's moment slots in the registry's
+    layout (train/optim.py): None (stateless sgd), a params-shaped
+    tree (momentum/LARS — byte-identical to the historical layout), or
+    ``{"m": tree, "v": tree}`` (LAMB). Under
+    ``precision.master_weights``, ``params`` ARE the float32 masters;
+    the train step derives the low-precision forward view per step, so
+    no second param tree ever enters the state or its checkpoints.
     """
 
     params: Any
-    momentum: Any            # momentum buffers or None
+    momentum: Any            # optimizer moment slots or None
     step: jax.Array          # int32, loop iterations
     updates_applied: jax.Array  # int32, ≙ global_step
     root_key: jax.Array
@@ -179,24 +187,47 @@ def zero1_plan_for(model: Model, cfg: ExperimentConfig, topo: Topology,
                            min_leaf_size=par.shard_min_leaf_size)
 
 
+def resolved_param_dtype(cfg: ExperimentConfig):
+    """The dtype ``TrainState.params`` is STORED in: float32 masters
+    when ``precision.master_weights`` (the low-precision view is
+    derived per step), else ``precision.param_dtype`` itself. Typed
+    validation, matching the optim section's convention: a bad dtype
+    string is a ConfigError naming the key, not a numpy TypeError from
+    deep inside state init."""
+    from ..core.config import ConfigError
+    try:
+        dt = jnp.dtype(cfg.precision.param_dtype)
+    except TypeError as e:
+        raise ConfigError(
+            f"precision.param_dtype={cfg.precision.param_dtype!r} is not "
+            f"a recognized dtype ({e}); use e.g. 'float32' or 'bfloat16'"
+        ) from e
+    if not jnp.issubdtype(dt, jnp.floating):
+        raise ConfigError(
+            f"precision.param_dtype={cfg.precision.param_dtype!r} is not a "
+            "floating dtype")
+    return jnp.float32 if cfg.precision.master_weights else dt
+
+
 def state_partition_specs(model: Model, cfg: ExperimentConfig,
                           topo: Topology) -> TrainState:
     """A TrainState-shaped pytree of PartitionSpecs: P() (replicated)
     scalars, per-leaf engine-derived specs for param-shaped subtrees
     (tensor/pipeline/expert placements per the model's rule table), and
-    — under ``parallel.shard_weight_update`` — momentum buffers split
-    over the replica axis per the ZeRO-1 plan."""
+    — under ``parallel.shard_weight_update`` — optimizer moment slots
+    split over the replica axis per the ZeRO-1 plan (every slot of a
+    multi-slot optimizer shards the same way)."""
     from jax.sharding import PartitionSpec as P_
+    from ..train import optim as optim_lib
 
     abstract = abstract_train_params(model, cfg, topo)
     pspec = params_partition_specs(model, cfg, topo, params=abstract)
-    has_momentum = cfg.optim.momentum > 0.0
+    opt = optim_lib.make_optimizer(cfg.optim)
     interval = cfg.sync.mode == "interval"
     plan = zero1_plan_for(model, cfg, topo, params=abstract)
-    mspec = None
-    if has_momentum:
-        mspec = (zero1_state_specs(plan, pspec) if plan is not None
+    slot_spec = (zero1_state_specs(plan, pspec) if plan is not None
                  else pspec)
+    mspec = optim_lib.init_slots(opt, lambda: slot_spec)
     return TrainState(
         params=pspec,
         momentum=mspec,
@@ -207,14 +238,30 @@ def state_partition_specs(model: Model, cfg: ExperimentConfig,
 
 def init_train_state(model: Model, cfg: ExperimentConfig,
                      topo: Topology | None = None) -> TrainState:
+    from ..train import optim as optim_lib
+
     params = _build_params(model, cfg, topo)
+    store_dt = resolved_param_dtype(cfg)
+    if store_dt != jnp.float32:
+        # true low-precision training (no master copy): params are cast
+        # once here and updated in this dtype from now on
+        params = jax.tree.map(
+            lambda p: (p.astype(store_dt)
+                       if jnp.issubdtype(p.dtype, jnp.floating) else p),
+            params)
     plan = (zero1_plan_for(model, cfg, topo, params=params)
             if topo is not None else None)
-    if cfg.optim.momentum > 0.0:
-        momentum = (zero1_init_state(params, plan) if plan is not None
-                    else jax.tree.map(jnp.zeros_like, params))
-    else:
-        momentum = None
+    opt = optim_lib.make_optimizer(cfg.optim)
+
+    def one_slot_tree():
+        if plan is not None:
+            return zero1_init_state(params, plan,
+                                    dtype_fn=optim_lib.slot_dtype)
+        return jax.tree.map(
+            lambda p: jnp.zeros(p.shape, optim_lib.slot_dtype(p.dtype)),
+            params)
+
+    momentum = optim_lib.init_slots(opt, one_slot_tree)
     interval = cfg.sync.mode == "interval"
     return TrainState(
         params=params,
@@ -222,7 +269,11 @@ def init_train_state(model: Model, cfg: ExperimentConfig,
         step=jnp.zeros((), jnp.int32),
         updates_applied=jnp.zeros((), jnp.int32),
         root_key=prng.root_key(cfg.train.seed),
-        window_acc=jax.tree.map(jnp.zeros_like, params) if interval else None,
+        # fp32 always: the window accumulates float32 masked means even
+        # when params store low-precision (precision.param_dtype)
+        window_acc=(jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            if interval else None),
         window_rounds=jnp.zeros((), jnp.float32),
         wall_ms=jnp.zeros((), jnp.float32),
         next_apply_ms=jnp.asarray(cfg.sync.interval_ms, jnp.float32),
@@ -281,13 +332,61 @@ def restore_for_topology(model: Model, cfg: ExperimentConfig,
     A world change is reported through ``on_event`` as
     ``action: "cross_world_restore"`` naming both worlds — the
     journaled evidence the chaos cross-world resume invariant pairs
-    with the supervisor's ``event: "reconfigure"`` license."""
+    with the supervisor's ``event: "reconfigure"`` license.
+
+    **Cross-optimizer guard**: an artifact whose saved config carries a
+    different optimizer-STATE kind (none/momentum/lars/lamb —
+    train/optim.opt_state_kind) than this run raises the typed
+    :class:`~..train.checkpoint.OptimizerStateMismatchError` BEFORE any
+    graft is attempted. LARS and momentum state share a tree shape, so
+    a structural check alone would silently reinterpret one as the
+    other; and a shape mismatch (momentum tree into LAMB's
+    ``{"m","v"}`` slots) would surface as an opaque flax structure
+    error. Neither is a fallback-past-it condition — a kind mismatch
+    affects every step of the run equally."""
     from ..train import checkpoint as ckpt
+    from ..train import optim as optim_lib
+    try:
+        extra_got = ckpt.read_checkpoint_extra(train_dir, step)
+    except (OSError, ValueError, KeyError):
+        # unreadable/torn LATEST artifact: the restore call below owns
+        # corrupt-checkpoint fallback (older steps of the same run
+        # carry the same optimizer config, so the guard loses nothing)
+        extra_got = None
+    if extra_got is not None:
+        saved_extra, probe_step = extra_got
+        saved_optim = ((saved_extra or {}).get("config") or {}).get("optim")
+        saved_kind = optim_lib.saved_opt_state_kind(saved_optim)
+        want_kind = optim_lib.opt_state_kind(cfg.optim)
+        if saved_kind is not None and saved_kind != want_kind:
+            raise ckpt.OptimizerStateMismatchError(
+                f"checkpoint step={probe_step} in {train_dir} holds "
+                f"{saved_kind!r} optimizer state (saved optim config "
+                f"{saved_optim!r}) but this run's optim.name="
+                f"{cfg.optim.name!r} needs {want_kind!r} state; refusing "
+                "to graft mismatched opt-state trees — restore under the "
+                "saving optimizer, or start the new optimizer fresh "
+                "(train.resume=false / a fresh train_dir)",
+                saved_kind=saved_kind, requested_kind=want_kind)
     restored = ckpt.restore_checkpoint(train_dir, template_state,
                                        step=step, on_event=on_event)
     if restored is None:
         return None
     state, extra, got_step = restored
+    # precision portability: params are stored in the saving run's
+    # storage dtype (fp32 masters, or a low-precision no-master layout);
+    # normalize to THIS config's storage dtype so a precision-knob
+    # change never leaves a stale-dtype tree in the live state
+    store_dt = resolved_param_dtype(cfg)
+
+    def _to_storage_dtype(p):
+        dt = getattr(p, "dtype", None)
+        if dt is None or not jnp.issubdtype(jnp.dtype(dt), jnp.floating):
+            return p
+        return p if jnp.dtype(dt) == store_dt else p.astype(store_dt)
+
+    state = state.replace(params=jax.tree.map(_to_storage_dtype,
+                                              state.params))
     # the plan (padding, chunk ownership) comes from the CURRENT
     # replica count — never the saver's n
     plan = zero1_plan_for(model, cfg, topo)
@@ -312,33 +411,78 @@ def canonical_save_state(state: TrainState,
     artifact (and its canonical path digest, train/checkpoint.py) is
     byte-stable across ``parallel.shard_weight_update`` settings and a
     sharded run's checkpoint restores onto a replicated config (and
-    vice versa) with no migration. Host-side; a no-op without a plan."""
+    vice versa) with no migration. Multi-slot optimizer state (LAMB's
+    first/second moments) unpacks per slot, same contract. Host-side; a
+    no-op without a plan."""
+    from ..train import optim as optim_lib
     if plan is None or state.momentum is None:
         return state
-    return state.replace(momentum=zero1_unpack(state.momentum, plan))
+    return state.replace(momentum=optim_lib.map_slots(
+        lambda tree: zero1_unpack(tree, plan), state.momentum))
 
 
 def pack_restored_state(state: TrainState,
                         plan: Zero1Plan | None) -> TrainState:
     """Inverse of :func:`canonical_save_state` on the restore path:
-    fold canonically-saved (logical-shape) momentum back into the
-    flattened-padded replica-shard layout the live state uses. Exact —
-    padding is zeros, truncation only ever removes padding."""
+    fold canonically-saved (logical-shape) optimizer slots back into
+    the flattened-padded replica-shard layout the live state uses.
+    Exact — padding is zeros, truncation only ever removes padding."""
+    from ..train import optim as optim_lib
     if plan is None or state.momentum is None:
         return state
-    return state.replace(momentum=zero1_pack(state.momentum, plan))
+    return state.replace(momentum=optim_lib.map_slots(
+        lambda tree: zero1_pack(tree, plan), state.momentum))
 
 
-def _sgd(params: Any, grads: Any, momentum_bufs: Any, lr: jax.Array,
-         momentum: float) -> tuple[Any, Any]:
-    """Plain SGD (≙ tf.train.GradientDescentOptimizer,
-    src/distributed_train.py:176), with optional heavyball momentum."""
-    if momentum_bufs is None:
-        new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
-        return new_params, None
-    new_bufs = jax.tree.map(lambda b, g: momentum * b + g, momentum_bufs, grads)
-    new_params = jax.tree.map(lambda p, b: p - lr * b, params, new_bufs)
-    return new_params, new_bufs
+def _spec_norm_axes(spec) -> tuple[str, ...]:
+    """The mesh axes a PartitionSpec pins any dim to — what a partial
+    leaf's sum-of-squares must psum over so the trust-ratio math sees
+    the FULL logical leaf's norms (TP/stage/expert placements hold
+    shards inside shard_map)."""
+    axes: list[str] = []
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            axes.extend(a for a in entry if a is not None)
+        else:
+            axes.append(entry)
+    return tuple(axes)
+
+
+def _apply_tree_update(opt, params: Any, grads: Any, opt_state: Any,
+                       lr: jax.Array, t: jax.Array,
+                       param_specs: Any) -> tuple[Any, Any]:
+    """The replicated-discipline weight update: map the optimizer's
+    pure per-leaf rule (train/optim.py) over full logical leaves.
+    ``norm_reduce`` completes partial sums over whatever non-replica
+    axes a leaf is sharded on (its PartitionSpec); fully-replicated
+    leaves reduce with the identity. NO masking guard here — callers
+    own the all-masked no-op semantics (lr·applied for stateless sgd,
+    a select for stateful optimizers whose moments would decay)."""
+    from ..train import optim as optim_lib
+
+    p_leaves, treedef = jax.tree.flatten(params)
+    g_leaves = treedef.flatten_up_to(grads)
+    spec_leaves = treedef.flatten_up_to(param_specs)
+    in_slot_trees = optim_lib.slot_trees(opt, opt_state)
+    slot_leaves = [treedef.flatten_up_to(tr) for tr in in_slot_trees]
+
+    new_p: list = []
+    new_slots: list[list] = [[] for _ in in_slot_trees]
+    for i, (p, g, spec) in enumerate(zip(p_leaves, g_leaves, spec_leaves)):
+        axes = _spec_norm_axes(spec)
+        nr = ((lambda x, a=axes: lax.psum(x, a)) if axes
+              else (lambda x: x))
+        slots = tuple(sl[i] for sl in slot_leaves)
+        np_, ns = opt.update_leaf(p, g, slots, lr, t, nr,
+                                  adapt=len(getattr(p, "shape", ())) > 1)
+        new_p.append(np_)
+        for j, s in enumerate(ns):
+            new_slots[j].append(s)
+    return (jax.tree.unflatten(treedef, new_p),
+            optim_lib.from_slot_trees(
+                opt, [jax.tree.unflatten(treedef, sl) for sl in new_slots]))
 
 
 def _pad_flat(x: jax.Array, lp) -> jax.Array:
@@ -352,27 +496,33 @@ def _pad_flat(x: jax.Array, lp) -> jax.Array:
         [flat, jnp.zeros((lp.pad - lp.size,), flat.dtype)])
 
 
-def _zero1_update(params: Any, grads: Any, momentum_bufs: Any,
-                  flag: jax.Array, lr: jax.Array, momentum: float,
-                  axis: str, plan: Zero1Plan
+def _zero1_update(params: Any, grads: Any, opt_state: Any,
+                  flag: jax.Array, lr: jax.Array, t: jax.Array,
+                  axis: str, plan: Zero1Plan, opt, param_specs: Any
                   ) -> tuple[Any, Any, jax.Array, jax.Array]:
     """The ZeRO-1 weight-update discipline (arXiv:2004.13336), inside
     shard_map: per sharded leaf, the masked gradients are
     REDUCE-SCATTERED over the replica axis (each replica receives the
     summed 1/n slice — the full mean gradient is never materialized),
-    the optimizer state and param slice are updated locally, and the
-    fresh param slices are allgathered back to the replicated layout
-    the forward pass consumes. Fallback leaves (tensor-parallel
-    placements, leaves below the shard floor) take the classic
-    replicated psum + full update.
+    the optimizer's moment slots and param slice are updated locally
+    via the same pure per-leaf rule the replicated path uses
+    (train/optim.py — trust-ratio norms complete over the replica axis,
+    exact because ZeRO padding is zeros), and the fresh param slices
+    are allgathered back to the replicated layout the forward pass
+    consumes. Fallback leaves (tensor-parallel placements, leaves below
+    the shard floor) take the classic replicated psum + full update,
+    with their norms completed over whatever axes their spec shards.
 
     Masking semantics match the replicated path exactly: gradients are
     pre-scaled by ``flag / max(psum(flag), 1)`` so the scattered sum IS
-    the masked mean, and an all-masked step is a true no-op (plain SGD
-    scales lr by the applied flag; momentum decay is select-guarded).
+    the masked mean, and an all-masked step is a true no-op (stateless
+    SGD scales lr by the applied flag; stateful optimizers — whose
+    moments would decay — are select-guarded).
 
-    Returns ``(new_params, new_bufs, num_contributors, applied)``.
+    Returns ``(new_params, new_opt_state, num_contributors, applied)``.
     """
+    from ..train import optim as optim_lib
+
     scale, num = contribution_scale(flag, axis)
     applied = (num > 0).astype(jnp.int32)
     me = lax.axis_index(axis)
@@ -380,15 +530,24 @@ def _zero1_update(params: Any, grads: Any, momentum_bufs: Any,
     p_leaves, treedef = jax.tree.flatten(params)
     g_leaves = treedef.flatten_up_to(grads)
     lp_leaves = treedef.flatten_up_to(plan.leaf_plans)
-    b_leaves = (treedef.flatten_up_to(momentum_bufs)
-                if momentum_bufs is not None else [None] * len(p_leaves))
-    # plain SGD: lr·0 is exact, so scaling lr by the applied flag IS
-    # the all-masked no-op (same trick as the replicated path)
-    lr_plain = lr * applied.astype(jnp.float32)
+    spec_leaves = treedef.flatten_up_to(param_specs)
+    in_slot_trees = optim_lib.slot_trees(opt, opt_state)
+    slot_leaves = [treedef.flatten_up_to(tr) for tr in in_slot_trees]
+    stateless = opt.num_slots == 0
+    # stateless sgd: lr·0 is exact, so scaling lr by the applied flag
+    # IS the all-masked no-op (same trick as the replicated path)
+    lr_eff = lr * applied.astype(jnp.float32) if stateless else lr
 
-    new_p, new_b = [], []
-    for p, g, b, lp in zip(p_leaves, g_leaves, b_leaves, lp_leaves):
+    def guard(new, old):
+        return new if stateless else jnp.where(applied > 0, new, old)
+
+    new_p: list = []
+    new_slots: list[list] = [[] for _ in in_slot_trees]
+    for i, (p, g, lp, spec) in enumerate(
+            zip(p_leaves, g_leaves, lp_leaves, spec_leaves)):
         gm = g * scale.astype(g.dtype)
+        slots = tuple(sl[i] for sl in slot_leaves)
+        adapt = len(lp.shape) > 1
         if lp.sharded:
             # reduce-scatter: [pad] masked grads → this replica's
             # summed [chunk] slice (already the mean via the pre-scale)
@@ -396,33 +555,30 @@ def _zero1_update(params: Any, grads: Any, momentum_bufs: Any,
                                    scatter_dimension=0, tiled=True)
             psh = lax.dynamic_slice(_pad_flat(p, lp), (me * lp.chunk,),
                                     (lp.chunk,))
-            if b is None:
-                nps, nbs = psh - lr_plain * gsh, None
-            else:
-                nbs = momentum * b + gsh
-                nps = psh - lr * nbs
-                # momentum decays even on zero grads: true no-op needs
-                # the select (chunk-sized — 1/n of the replicated cost)
-                nps = jnp.where(applied > 0, nps, psh)
-                nbs = jnp.where(applied > 0, nbs, b)
+            nps, nslots = opt.update_leaf(
+                psh, gsh, slots, lr_eff, t,
+                lambda x: lax.psum(x, axis), adapt)
+            # select on the chunk — 1/n of the replicated guard cost
+            nps = guard(nps, psh)
+            nslots = tuple(guard(ns, s) for ns, s in zip(nslots, slots))
             full = mesh_lib.gather_chunks_replicated(
                 nps, axis, lp.pad, me * lp.chunk)
             new_p.append(full[:lp.size].reshape(lp.shape))
-            new_b.append(nbs)
         else:
             mean = lax.psum(gm, axis)
-            if b is None:
-                new_p.append(p - lr_plain * mean)
-                new_b.append(None)
-            else:
-                nb = momentum * b + mean
-                npv = p - lr * nb
-                new_p.append(jnp.where(applied > 0, npv, p))
-                new_b.append(jnp.where(applied > 0, nb, b))
+            axes = _spec_norm_axes(spec)
+            nr = ((lambda x, a=axes: lax.psum(x, a)) if axes
+                  else (lambda x: x))
+            npv, nslots = opt.update_leaf(p, mean, slots, lr_eff, t,
+                                          nr, adapt)
+            new_p.append(guard(npv, p))
+            nslots = tuple(guard(ns, s) for ns, s in zip(nslots, slots))
+        for j, s in enumerate(nslots):
+            new_slots[j].append(s)
     params_out = jax.tree.unflatten(treedef, new_p)
-    bufs_out = (jax.tree.unflatten(treedef, new_b)
-                if momentum_bufs is not None else None)
-    return params_out, bufs_out, num, applied
+    state_out = optim_lib.from_slot_trees(
+        opt, [jax.tree.unflatten(treedef, sl) for sl in new_slots])
+    return params_out, state_out, num, applied
 
 
 def _gather_replicated(x: jax.Array, axis: str, n: int) -> jax.Array:
@@ -461,7 +617,29 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
     if mode not in ("sync", "quorum", "timeout", "interval", "cdf"):
         raise ValueError(f"unknown sync mode {mode!r}")
     k = policies.resolve_aggregate_k(sync, n)
-    momentum = cfg.optim.momentum
+    from ..train import optim as optim_lib
+    opt = optim_lib.make_optimizer(cfg.optim)  # validates the section
+    # Gradient accumulation (train.grad_accum_steps): the step receives
+    # accum host batches concatenated along dim 0 and scans them as
+    # microbatches, accumulating gradients in float32 before ONE
+    # optimizer application — effective batch = data.batch_size × accum.
+    accum = max(1, int(cfg.train.grad_accum_steps))
+    # Mixed precision (cfg.precision): with master weights the state
+    # params are float32 and the forward pass sees a derived
+    # param_dtype view; differentiating w.r.t. the view is exact — the
+    # cast's transpose casts cotangents back, and grads are accumulated
+    # in float32 regardless.
+    param_dtype = jnp.dtype(cfg.precision.param_dtype)
+    fwd_cast = (cfg.precision.master_weights
+                and param_dtype != jnp.float32)
+
+    def fwd_view(params):
+        if not fwd_cast:
+            return params
+        return jax.tree.map(
+            lambda p: (p.astype(param_dtype)
+                       if jnp.issubdtype(p.dtype, jnp.floating) else p),
+            params)
 
     # Sequence parallelism: when the mesh spends devices on the seq
     # axis, the model must provide a sequence-sharded apply (the
@@ -555,6 +733,9 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
     # already device-varying there)
     grad_axes = (axis, seq_ax) if n_seq > 1 else (axis,)
     state_specs = state_partition_specs(model, cfg, topo)
+    # per-leaf param placements — what the trust-ratio norm reductions
+    # complete partial sums over for non-replica-sharded leaves
+    pspec_tree = state_specs.params
     # ZeRO-1 (parallel.shard_weight_update): reduce-scatter grads,
     # update only this replica's param/momentum slice, allgather fresh
     # params — per the engine's shard plan, which state_partition_specs
@@ -653,36 +834,79 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         # the raw per-shard gradient — masks must apply BEFORE the
         # replica aggregation, and the seq-axis psum must be explicit —
         # so cast params to varying over every grad axis first.
-        dkey = prng.replica_key(state.root_key, "dropout", step, me)
         local_params = jax.tree.map(
             lambda x: lax.pcast(x, grad_axes, to="varying"), state.params)
-        if pp_1f1b_grads_fn is not None:
-            # fused 1F1B: the engine computes loss, accuracy and grads
-            # in one interleaved scan — no outer value_and_grad. Under
-            # SP the engine returns per-seq-shard partials; psum
-            # reassembles the exact dense values (same as the SP
-            # branch below).
-            loss, train_acc, grads = pp_1f1b_grads_fn(
-                local_params, batch["image"], batch["label"])
-            if n_seq > 1:
-                loss = lax.psum(loss, seq_ax)
-                train_acc = lax.psum(train_acc, seq_ax)
+        # master weights: the forward sees the derived param_dtype view
+        fwd_params = fwd_view(local_params)
+
+        def compute_grads(mb_batch, dkey):
+            """(loss, train_acc, grads) for ONE microbatch — the
+            per-parallelism branch chain, shared by the single-shot and
+            the accumulation paths."""
+            if pp_1f1b_grads_fn is not None:
+                # fused 1F1B: the engine computes loss, accuracy and
+                # grads in one interleaved scan — no outer
+                # value_and_grad. Under SP the engine returns
+                # per-seq-shard partials; psum reassembles the exact
+                # dense values (same as the SP branch below).
+                loss, train_acc, grads = pp_1f1b_grads_fn(
+                    fwd_params, mb_batch["image"], mb_batch["label"])
+                if n_seq > 1:
+                    loss = lax.psum(loss, seq_ax)
+                    train_acc = lax.psum(train_acc, seq_ax)
+                    grads = jax.tree.map(lambda g: lax.psum(g, seq_ax),
+                                         grads)
+            elif local_loss_sp is not None:  # DP×SP×TP, or PP×SP
+                (loss_p, acc_p), grads = jax.value_and_grad(
+                    local_loss_sp, has_aux=True)(fwd_params, mb_batch, dkey)
+                # reassemble the full-sequence gradient / metrics
+                loss = lax.psum(loss_p, seq_ax)
+                train_acc = lax.psum(acc_p, seq_ax)
                 grads = jax.tree.map(lambda g: lax.psum(g, seq_ax), grads)
-        elif local_loss_sp is not None:  # DP×SP×TP, or PP×SP
-            (loss_p, acc_p), grads = jax.value_and_grad(
-                local_loss_sp, has_aux=True)(local_params, batch, dkey)
-            # reassemble the full-sequence gradient / metrics
-            loss = lax.psum(loss_p, seq_ax)
-            train_acc = lax.psum(acc_p, seq_ax)
-            grads = jax.tree.map(lambda g: lax.psum(g, seq_ax), grads)
-        elif pp_apply is not None:
-            (loss, logits), grads = jax.value_and_grad(
-                local_loss_pp, has_aux=True)(local_params, batch, dkey)
-            train_acc = model.accuracy(logits, batch["label"])
+            elif pp_apply is not None:
+                (loss, logits), grads = jax.value_and_grad(
+                    local_loss_pp, has_aux=True)(fwd_params, mb_batch, dkey)
+                train_acc = model.accuracy(logits, mb_batch["label"])
+            else:
+                (loss, logits), grads = jax.value_and_grad(
+                    local_loss, has_aux=True)(fwd_params, mb_batch, dkey)
+                train_acc = model.accuracy(logits, mb_batch["label"])
+            return loss, train_acc, grads
+
+        if accum == 1:
+            dkey = prng.replica_key(state.root_key, "dropout", step, me)
+            loss, train_acc, grads = compute_grads(batch, dkey)
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
         else:
-            (loss, logits), grads = jax.value_and_grad(
-                local_loss, has_aux=True)(local_params, batch, dkey)
-            train_acc = model.accuracy(logits, batch["label"])
+            # microbatch scan: fp32 accumulation, one optimizer apply.
+            # The local rows are any accum-way partition of this
+            # replica's slice of the effective batch — every sample
+            # carries weight 1/(accum·b_local) locally and 1/n across
+            # replicas, so the accumulated mean is exactly the
+            # effective-batch mean regardless of the grouping.
+            mb_batch = jax.tree.map(
+                lambda x: x.reshape((accum, x.shape[0] // accum)
+                                    + x.shape[1:]), batch)
+            g_zero = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), fwd_params)
+
+            def mb_body(carry, xs):
+                g_acc, l_acc, a_acc = carry
+                one_batch, idx = xs
+                dkey = prng.replica_key(state.root_key, "dropout",
+                                        step * accum + idx, me)
+                l, a, g = compute_grads(one_batch, dkey)
+                g_acc = jax.tree.map(
+                    lambda s, gi: s + gi.astype(jnp.float32), g_acc, g)
+                return (g_acc, l_acc + l, a_acc + a), None
+
+            (g_sum, l_sum, a_sum), _ = lax.scan(
+                mb_body, (g_zero, jnp.zeros((), jnp.float32),
+                          jnp.zeros((), jnp.float32)),
+                (mb_batch, jnp.arange(accum)))
+            grads = jax.tree.map(lambda g: g / accum, g_sum)
+            loss = l_sum / accum
+            train_acc = a_sum / accum
 
         # --- per-worker drop-connect before aggregation
         # (src/distributed_train.py:194-196) --------------------------
@@ -703,6 +927,7 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
             flag = policies.timeout_flag(t_ms, sync.interval_ms)
 
         # --- apply discipline ----------------------------------------
+        t_next = state.updates_applied.astype(jnp.float32) + 1.0
         if mode == "interval":
             mean_grads, num_contrib = masked_mean_psum(grads, flag, axis)
             new_state, applied = _interval_apply(state, mean_grads, t_ms)
@@ -711,11 +936,11 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
             # reduce-scatter inside _zero1_update hands each replica
             # its slice of it directly
             lr = schedule(state.updates_applied)
-            new_params, new_bufs, num_contrib, applied = _zero1_update(
-                state.params, grads, state.momentum, flag, lr, momentum,
-                axis, z_plan)
+            new_params, new_opt, num_contrib, applied = _zero1_update(
+                state.params, grads, state.momentum, flag, lr, t_next,
+                axis, z_plan, opt, pspec_tree)
             new_state = state.replace(
-                params=new_params, momentum=new_bufs,
+                params=new_params, momentum=new_opt,
                 updates_applied=state.updates_applied + applied)
         else:
             mean_grads, num_contrib = masked_mean_psum(grads, flag, axis)
@@ -723,27 +948,28 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
             applied = (num_contrib > 0).astype(jnp.int32)
             # If every replica was masked out (possible under timeout),
             # the mean is zero and the update must be a true no-op.
-            if state.momentum is None:
-                # plain SGD: lr·0 is exact, so scaling the scalar lr by
-                # the applied flag IS the no-op — no full-size
+            if opt.num_slots == 0:
+                # stateless sgd: lr·0 is exact, so scaling the scalar
+                # lr by the applied flag IS the no-op — no full-size
                 # per-parameter select pass (a measured throughput tax
                 # on small steps, bench_mode_overhead)
-                new_params, new_bufs = _sgd(
-                    state.params, mean_grads, None,
-                    lr * applied.astype(jnp.float32), momentum)
+                new_params, new_opt = _apply_tree_update(
+                    opt, state.params, mean_grads, None,
+                    lr * applied.astype(jnp.float32), t_next, pspec_tree)
             else:
-                new_params, new_bufs = _sgd(state.params, mean_grads,
-                                            state.momentum, lr, momentum)
-                # momentum buffers decay even on zero gradients, so a
-                # true no-op needs the select
+                new_params, new_opt = _apply_tree_update(
+                    opt, state.params, mean_grads, state.momentum, lr,
+                    t_next, pspec_tree)
+                # moment slots decay even on zero gradients, so a true
+                # no-op needs the select
                 new_params = jax.tree.map(
                     lambda new, old: jnp.where(applied > 0, new, old),
                     new_params, state.params)
-                new_bufs = jax.tree.map(
+                new_opt = jax.tree.map(
                     lambda new, old: jnp.where(applied > 0, new, old),
-                    new_bufs, state.momentum)
+                    new_opt, state.momentum)
             new_state = state.replace(
-                params=new_params, momentum=new_bufs,
+                params=new_params, momentum=new_opt,
                 updates_applied=state.updates_applied + applied)
 
         new_state = new_state.replace(step=step + 1)
@@ -786,8 +1012,9 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
 
         lr = schedule(state.updates_applied)
         window_mean = jax.tree.map(lambda a: a / rounds, acc)
-        applied_params, applied_bufs = _sgd(state.params, window_mean,
-                                            state.momentum, lr, momentum)
+        applied_params, applied_bufs = _apply_tree_update(
+            opt, state.params, window_mean, state.momentum, lr,
+            state.updates_applied.astype(jnp.float32) + 1.0, pspec_tree)
 
         def pick(new, old):
             return jax.tree.map(lambda a, b: jnp.where(fire, a, b), new, old)
@@ -992,7 +1219,8 @@ def build_weight_update_step(model: Model, cfg: ExperimentConfig,
     right shapes.
     """
     axis = topo.replica_axis
-    momentum = cfg.optim.momentum
+    from ..train import optim as optim_lib
+    opt = optim_lib.make_optimizer(cfg.optim)
     if cfg.sync.mode == "interval":
         raise ValueError("build_weight_update_step models the per-step "
                          "apply disciplines; interval mode applies on a "
@@ -1004,16 +1232,18 @@ def build_weight_update_step(model: Model, cfg: ExperimentConfig,
     def shard_fn(state: TrainState, grads: Any) -> TrainState:
         flag = jnp.ones((), jnp.float32)
         lr = schedule(state.updates_applied)
+        t_next = state.updates_applied.astype(jnp.float32) + 1.0
         if z_plan is not None:
-            new_params, new_bufs, _, applied = _zero1_update(
-                state.params, grads, state.momentum, flag, lr, momentum,
-                axis, z_plan)
+            new_params, new_opt, _, applied = _zero1_update(
+                state.params, grads, state.momentum, flag, lr, t_next,
+                axis, z_plan, opt, grad_specs)
         else:
             mean_grads, num = masked_mean_psum(grads, flag, axis)
-            new_params, new_bufs = _sgd(state.params, mean_grads,
-                                        state.momentum, lr, momentum)
+            new_params, new_opt = _apply_tree_update(
+                opt, state.params, mean_grads, state.momentum, lr,
+                t_next, grad_specs)
             applied = (num > 0).astype(jnp.int32)
-        return state.replace(params=new_params, momentum=new_bufs,
+        return state.replace(params=new_params, momentum=new_opt,
                              step=state.step + 1,
                              updates_applied=state.updates_applied + applied)
 

@@ -181,12 +181,18 @@ def zero1_state_specs(plan: Zero1Plan, param_specs: Any) -> Any:
         plan.leaf_plans, param_specs)
 
 
-def zero1_init_state(params: Any, plan: Zero1Plan) -> Any:
-    """Zeros-initialized optimizer-state tree in the plan's layout."""
+def zero1_init_state(params: Any, plan: Zero1Plan,
+                     dtype_fn: Callable[[Any], Any] | None = None) -> Any:
+    """Zeros-initialized optimizer-state tree in the plan's layout.
+    ``dtype_fn(param_dtype) -> slot_dtype`` lets moment slots differ
+    from the param dtype (float32 moments over bf16 params — see
+    train/optim.slot_dtype); default: the param dtype, the historical
+    layout."""
     import jax.numpy as jnp
+    dt = dtype_fn if dtype_fn is not None else (lambda d: d)
     return jax.tree.map(
-        lambda p, lp: (jnp.zeros((lp.pad,), p.dtype) if lp.sharded
-                       else jnp.zeros_like(p)),
+        lambda p, lp: (jnp.zeros((lp.pad,), dt(p.dtype)) if lp.sharded
+                       else jnp.zeros(p.shape, dt(p.dtype))),
         params, plan.leaf_plans)
 
 

@@ -53,7 +53,8 @@ from typing import Any
 import jax
 import numpy as np
 
-from ..core.config import ExperimentConfig, MeshConfig, ServeConfig
+from ..core.config import (ExperimentConfig, MeshConfig, ServeConfig,
+                           effective_model_config)
 from ..core.log import JsonlSink, get_logger
 from ..core.mesh import Topology, make_topology
 from ..models.registry import get_model
@@ -112,7 +113,7 @@ class ServingReplica:
                     "layouts; serve from a non-pipeline checkpoint")
             self.topo = make_topology(MeshConfig(num_replicas=1),
                                       devices=jax.devices()[:1])
-        self.model = get_model(cfg.model)
+        self.model = get_model(effective_model_config(cfg))
         self.template = init_train_state(self.model, cfg, self.topo)
         self._param_specs = state_partition_specs(
             self.model, cfg, self.topo).params

@@ -82,6 +82,24 @@ class WorldSizeMismatchError(ValueError):
         self.requested_world = requested_world
 
 
+class OptimizerStateMismatchError(ValueError):
+    """A checkpoint carries a different optimizer-STATE kind
+    (none/momentum/lars/lamb — train/optim.opt_state_kind) than the
+    restoring run's config. Like :class:`WorldSizeMismatchError`, this
+    is deliberately NOT a :class:`CheckpointCorruptError`: the mismatch
+    affects every step of the run equally, so the restore must surface
+    it — naming both kinds — rather than fall back past the whole run
+    or silently graft one optimizer's moments into another's slots
+    (momentum and LARS state even share a tree shape, so the structural
+    graft would SUCCEED and quietly corrupt the trust-ratio math)."""
+
+    def __init__(self, msg: str, saved_kind: str | None = None,
+                 requested_kind: str | None = None):
+        super().__init__(msg)
+        self.saved_kind = saved_kind
+        self.requested_kind = requested_kind
+
+
 # -- I/O retry wrapper ------------------------------------------------------
 #
 # Checkpoint reads/writes hit network filesystems in production; a

@@ -35,7 +35,8 @@ from ..parallel.api import (TrainState, build_eval_step, build_train_step,
                             restore_for_topology, state_partition_specs,
                             world_signature, zero1_plan_for)
 from . import checkpoint as ckpt
-from .lr_schedule import constant, decay_steps_for, exponential_decay
+from .lr_schedule import (constant, decay_steps_for, exponential_decay,
+                          warmup_polynomial_decay)
 
 logger = get_logger("train")
 
@@ -67,7 +68,11 @@ class Trainer:
                  datasets: Datasets | None = None):
         self.cfg = cfg
         self.topo = topo or make_topology(cfg.mesh)
-        self.model: Model = get_model(cfg.model)
+        # precision.compute_dtype overrides the model section's knob
+        # when set — one shared resolution (core.config) so the
+        # evaluator/serving tiers build the identical model
+        from ..core.config import effective_model_config
+        self.model: Model = get_model(effective_model_config(cfg))
         self.datasets = datasets if datasets is not None else load_datasets(
             cfg.data, cfg.model.image_size, cfg.model.num_channels,
             cfg.model.num_classes, cfg.model.seq_len, cfg.model.vocab_size)
@@ -76,6 +81,14 @@ class Trainer:
         if cfg.data.batch_size % n != 0:
             raise ValueError(f"global batch {cfg.data.batch_size} not divisible "
                              f"by {n} replicas")
+        if cfg.train.grad_accum_steps < 1:
+            raise ValueError(f"train.grad_accum_steps must be >= 1, got "
+                             f"{cfg.train.grad_accum_steps}")
+        self.grad_accum = int(cfg.train.grad_accum_steps)
+        # images/sec accounting and the epoch-based decay pacing both
+        # key off the EFFECTIVE batch — one optimizer application
+        # consumes batch_size × accum examples
+        self.effective_batch = cfg.data.batch_size * self.grad_accum
         # DP×SP: tokens sharded over the seq axis too (transformer only)
         n_seq = self.topo.mesh.shape[self.topo.seq_axis]
         self.seq_sharded = n_seq > 1
@@ -95,13 +108,22 @@ class Trainer:
                     f"pipeline_parallelism {n_stage}")
         from ..parallel.policies import resolve_aggregate_k
         k = resolve_aggregate_k(cfg.sync, n)
-        # LR schedule keyed to applied updates; decay_steps ÷ k
-        # (src/distributed_train.py:143-156).
-        if cfg.optim.learning_rate_decay_factor == 1.0:
+        # LR schedule keyed to applied updates.
+        if cfg.optim.schedule == "polynomial":
+            # linear warmup + polynomial decay — the LARS/LAMB
+            # large-batch pacing (train/lr_schedule.py);
+            # decay_total_steps=0 resolves to the run's step budget
+            total = cfg.optim.decay_total_steps or cfg.train.max_steps
+            self.schedule = warmup_polynomial_decay(
+                cfg.optim.initial_learning_rate, cfg.optim.warmup_steps,
+                total, cfg.optim.end_learning_rate, cfg.optim.poly_power)
+        elif cfg.optim.learning_rate_decay_factor == 1.0:
             self.schedule = constant(cfg.optim.initial_learning_rate)
         else:
+            # exponential staircase; decay_steps ÷ k
+            # (src/distributed_train.py:143-156)
             steps = decay_steps_for(self.datasets.train.num_examples,
-                                    cfg.data.batch_size,
+                                    self.effective_batch,
                                     cfg.optim.num_epochs_per_decay, k)
             self.schedule = exponential_decay(
                 cfg.optim.initial_learning_rate, steps,
@@ -123,6 +145,13 @@ class Trainer:
         self.train_iter = make_train_iterator(
             self.datasets.train, cfg.data, seed=cfg.train.seed,
             host_id=jax.process_index(), num_hosts=jax.process_count())
+        if self.grad_accum > 1:
+            # accum consecutive batches concatenated per step; the
+            # inner cursor just advances accum batches per step
+            # (data/pipeline.py GradAccumFeed)
+            from ..data.pipeline import GradAccumFeed
+            self.train_iter = GradAccumFeed(self.train_iter,
+                                            self.grad_accum)
 
         # Dispatch-ahead feed: batches staged through device_put_batch
         # on a producer thread, device_prefetch_depth ahead, so host
@@ -439,7 +468,7 @@ class Trainer:
             return self._compile_info
         img = self.datasets.train.images
         lbl = self.datasets.train.labels
-        B = self.cfg.data.batch_size
+        B = self.effective_batch  # accum batches arrive concatenated
         batch = {"image": np.zeros((B, *img.shape[1:]), img.dtype),
                  "label": np.zeros((B, *lbl.shape[1:]), lbl.dtype)}
         gbatch = self.topo.device_put_batch(batch,
@@ -572,7 +601,7 @@ class Trainer:
             if not pending:
                 return
             upto = pending[-1][0]
-            rate = ((upto - last_log_step) * self.cfg.data.batch_size
+            rate = ((upto - last_log_step) * self.effective_batch
                     / max(now - last_log_t, 1e-9))
             # NaN/Inf guard scans the WHOLE window before anything is
             # written: a mid-window raise would have already emitted the

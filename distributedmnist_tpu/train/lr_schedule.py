@@ -48,3 +48,31 @@ def constant(lr: float) -> Schedule:
         del updates_applied
         return jnp.asarray(lr, jnp.float32)
     return schedule
+
+
+def warmup_polynomial_decay(base_lr: float, warmup_steps: int,
+                            total_steps: int, end_lr: float = 0.0,
+                            power: float = 2.0) -> Schedule:
+    """Linear warmup to ``base_lr`` over ``warmup_steps`` applied
+    updates, then polynomial decay to ``end_lr`` at ``total_steps`` —
+    the MLPerf large-batch recipe (arXiv:1909.09756 §3: LARS/LAMB pair
+    with warmup + polynomial decay; power=2 is the MLPerf-0.6 setting).
+    Keyed, like every schedule here, to *applied updates* so pacing is
+    invariant to masked no-op steps. Past ``total_steps`` the rate
+    holds at ``end_lr``."""
+    if total_steps <= 0:
+        raise ValueError(f"total_steps must be > 0, got {total_steps}")
+    if warmup_steps >= total_steps:
+        raise ValueError(f"warmup_steps ({warmup_steps}) must be < "
+                         f"total_steps ({total_steps})")
+
+    def schedule(updates_applied: jax.Array) -> jax.Array:
+        t = jnp.asarray(updates_applied, jnp.float32)
+        base = jnp.asarray(base_lr, jnp.float32)
+        # warmup ramps 1/w, 2/w, … so update 0 never applies a zero lr
+        warm = base * (t + 1.0) / max(warmup_steps, 1)
+        frac = jnp.clip((t - warmup_steps)
+                        / max(total_steps - warmup_steps, 1), 0.0, 1.0)
+        decayed = (base - end_lr) * jnp.power(1.0 - frac, power) + end_lr
+        return jnp.where(t < warmup_steps, warm, decayed)
+    return schedule

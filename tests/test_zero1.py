@@ -222,6 +222,51 @@ def test_checkpoint_roundtrip_and_cross_knob_restore(tmp_path,
             assert leaf.shape == (lp.pad,)
 
 
+def test_cross_optimizer_restore_is_typed_error(tmp_path,
+                                                synthetic_datasets):
+    """Saving under one optimizer and restoring under another must
+    raise the typed OptimizerStateMismatchError, not silently graft
+    mismatched opt-state trees (momentum and LARS state even share a
+    tree SHAPE, so a structural check alone would quietly corrupt the
+    trust-ratio math)."""
+    d = str(tmp_path / "xopt")
+    Trainer(_trainer_cfg(False, d), datasets=synthetic_datasets).run()
+
+    def trainer_with(optim_over):
+        cfg = base_config(
+            optim=optim_over,
+            parallel={"shard_weight_update": False},
+            train={"max_steps": 4, "log_every_steps": 2,
+                   "save_interval_steps": 2, "save_results_period": 0,
+                   "train_dir": d, "async_checkpoint": False})
+        return Trainer(cfg, datasets=synthetic_datasets)
+
+    # saved under momentum-SGD (the _trainer_cfg default): every other
+    # state kind refuses, naming both sides
+    for other in ({"name": "lamb", "momentum": 0.0},
+                  {"name": "lars", "momentum": 0.0},
+                  {"momentum": 0.0}):  # stateless sgd
+        with pytest.raises(ckpt.OptimizerStateMismatchError,
+                           match="momentum"):
+            trainer_with(other)
+
+    # same kind under a different hyperparameter restores fine
+    t = trainer_with({"momentum": 0.8})
+    assert int(jax.device_get(t.state.step)) == 4
+
+    # the reverse direction: a lamb artifact refuses a momentum restore
+    d2 = str(tmp_path / "xopt_lamb")
+    cfg_lamb = base_config(
+        optim={"name": "lamb", "momentum": 0.0,
+               "initial_learning_rate": 1e-3},
+        train={"max_steps": 4, "log_every_steps": 2,
+               "save_interval_steps": 2, "save_results_period": 0,
+               "train_dir": d2, "async_checkpoint": False})
+    Trainer(cfg_lamb, datasets=synthetic_datasets).run()
+    with pytest.raises(ckpt.OptimizerStateMismatchError, match="lamb"):
+        Trainer(_trainer_cfg(False, d2), datasets=synthetic_datasets)
+
+
 def test_determinism_invariant_covers_opt_state(tmp_path):
     """obsv/invariants.py #3: identical artifacts pass with the
     opt-state digest compared (not skipped); a doctored momentum buffer
