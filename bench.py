@@ -705,6 +705,258 @@ def bench_weight_update_sharding() -> dict:
             **_env_stamp()}}
 
 
+def bench_zero1_overlap() -> dict:
+    """Bucketed ZeRO-1 comm overlap (ISSUE 12, arXiv:1810.11112):
+    monolithic (comm_buckets=1) vs bucketed (comm_buckets=4) FULL train
+    step on the flagship CNN with momentum, interleaved-repeat medians.
+    Reports ``overlap_ratio`` = bucketed/monolithic median step time
+    (< 1.0 = the regrouped collectives overlapped compute). Gate,
+    backend-dependent (the weak_scaling precedent):
+
+      * accelerators — bucketed ≤ 1.0× monolithic: real overlap
+        hardware must never lose to the monolithic discipline.
+      * CPU mesh — bucketed ≤ 1.05×: the virtual devices' collectives
+        serialize on the host, so the claim is PARITY within the
+        measured interleaved-repeat noise (readings straddle 1.0 by
+        ±2-3% run to run — the r05 cdf lesson; README documents
+        "leave buckets at 1 on CPU meshes").
+
+    The lowered StableHLO of both arms is hashed as structural
+    evidence (the PR 10 cdf precedent, inverted): the programs
+    genuinely differ — bucketed carries fewer, larger collectives —
+    so the gate measures a real regrouping, and bitwise-equal
+    numerics are pinned separately in tests/test_zero1.py."""
+    from distributedmnist_tpu.data.datasets import make_synthetic
+
+    n_dev = len(jax.devices())
+    if n_dev <= 1:
+        return {"metric": "zero1_overlap", "value": None,
+                "unit": "x (bucketed/monolithic median step time)",
+                "passes_gate": None,
+                "skipped": ("single-replica mesh — comm bucketing needs "
+                            "n_replica > 1 (force a multi-device mesh, "
+                            "e.g. XLA_FLAGS=--xla_force_host_platform_"
+                            "device_count=8)"),
+                "detail": _env_stamp()}
+
+    # CI-affordable sizes: the gate is a RATIO of comm disciplines on
+    # the same step, not a throughput anchor
+    batch = 128 * n_dev
+    ds = make_synthetic(num_train=batch, num_test=64)
+    host_batch = {"image": ds.train.images[:batch],
+                  "label": ds.train.labels[:batch]}
+    arms = {"monolithic": 1, "bucketed": 4}
+    # back-to-back dispatched steps, NOT the _ChunkTimer scan: XLA's
+    # while-loop + collective-scheduling passes make a scanned zero1
+    # step pathologically slow to compile on this CPU mesh (measured
+    # ~6 min for a 5-step scan vs ~4 s for the step itself). A python
+    # dispatch loop drained once per chunk keeps the device queue
+    # saturated, which is all a same-host ratio needs.
+    chunk_len, n_pairs = 5, 6
+
+    import hashlib
+
+    from distributedmnist_tpu.core.config import ExperimentConfig
+    from distributedmnist_tpu.core.mesh import make_topology
+    from distributedmnist_tpu.models.registry import get_model
+    from distributedmnist_tpu.parallel.api import (
+        build_train_step, init_train_state, state_partition_specs)
+    from distributedmnist_tpu.train.lr_schedule import constant
+
+    topo = make_topology()
+    timers: dict = {}  # arm name -> measure(n_steps) -> wall seconds
+    programs: dict[str, dict] = {}
+    for name, buckets in arms.items():
+        # init WITH the topology: the ZeRO-1 plan shapes the momentum
+        # (and state specs) — bench._build's topo-less init would hand
+        # the sharded step a replicated-layout state
+        cfg = ExperimentConfig.from_dict({
+            "data": {"dataset": "synthetic", "batch_size": batch},
+            "model": {"compute_dtype": "float32"},
+            "optim": {"momentum": 0.9},
+            "parallel": {"shard_weight_update": True,
+                         "comm_buckets": buckets},
+            "sync": {"mode": "sync"},
+        })
+        model = get_model(cfg.model)
+        state = topo.device_put_state(
+            init_train_state(model, cfg, topo),
+            state_partition_specs(model, cfg, topo))
+        step_fn = build_train_step(model, cfg, topo, constant(8e-4))
+        gbatch = topo.device_put_batch(host_batch)
+        try:
+            txt = step_fn.jitted.lower(state, gbatch,
+                                       topo.zeros_measured()).as_text()
+            programs[name] = {
+                "stablehlo_lines": txt.count("\n"),
+                "stablehlo_sha256": hashlib.sha256(
+                    txt.encode()).hexdigest()[:16]}
+        except Exception as e:
+            programs[name] = {"error": f"{type(e).__name__}: {e}"}
+        # compile + one warm step, then a dispatch-loop runner
+        st, m = step_fn(state, gbatch)
+        _drain(m)
+        holder = {"state": st}
+
+        def measure(n_steps, holder=holder, step_fn=step_fn,
+                    gbatch=gbatch):
+            st = holder["state"]
+            t0 = time.perf_counter()
+            for _ in range(n_steps):
+                st, m = step_fn(st, gbatch)
+            _drain(m)  # the queue ran the steps back-to-back
+            holder["state"] = st
+            return time.perf_counter() - t0
+
+        timers[name] = measure
+
+    # chunk-level interleave: each PAIR times the two arms back-to-back
+    # (seconds apart, not a whole arm-sweep apart) and contributes one
+    # bucketed/monolithic ratio — box-level drift over the run cancels
+    # within pairs instead of landing on whichever arm ran later (the
+    # failure mode arm-granularity interleaving measured here: ±5%
+    # repeat drift flipping a ~1.0 ratio)
+    rates: dict[str, list[float]] = {name: [] for name in arms}
+    pair_ratios: list[float] = []
+    for _ in range(n_pairs):
+        dt_m = timers["monolithic"](chunk_len)
+        dt_b = timers["bucketed"](chunk_len)
+        rates["monolithic"].append(chunk_len / dt_m)
+        rates["bucketed"].append(chunk_len / dt_b)
+        pair_ratios.append(dt_b / dt_m)
+
+    med = {name: statistics.median(r) for name, r in rates.items()}
+    overlap_ratio = statistics.median(pair_ratios)  # step-time ratio
+    cpu = jax.default_backend() == "cpu"
+    bound = 1.05 if cpu else 1.0
+    passes = overlap_ratio <= bound
+    gate = (("cpu mesh: bucketed median step time ≤ 1.05× monolithic — "
+             "host-serialized collectives make the honest claim parity "
+             "within the measured ±2-3% repeat noise; accelerators gate "
+             "≤ 1.0×") if cpu else
+            "accelerator: bucketed median step time ≤ 1.0× monolithic")
+    return {
+        "metric": "zero1_overlap",
+        "value": round(overlap_ratio, 3),
+        "unit": "x (bucketed/monolithic median step time)",
+        "passes_gate": bool(passes),
+        "detail": {
+            "gate": (f"{gate}; median of {n_pairs} back-to-back "
+                     "chunk-pair ratios"),
+            "n_replicas": n_dev, "batch": batch,
+            "comm_buckets": arms["bucketed"],
+            "ratio_by_pair": [round(r, 3) for r in pair_ratios],
+            "steps_per_sec_median": {k: round(v, 3)
+                                     for k, v in med.items()},
+            "steps_per_sec_by_pair": {
+                k: [round(r, 3) for r in v] for k, v in rates.items()},
+            # structural evidence the regrouping is real: the two arms
+            # lower to DIFFERENT programs (unlike the cdf case, where
+            # hash identity proved the overhead was capture noise)
+            "program": programs,
+            "programs_differ": (
+                "error" not in programs.get("monolithic", {"error": 1})
+                and "error" not in programs.get("bucketed", {"error": 1})
+                and programs["monolithic"] != programs["bucketed"]),
+            **_env_stamp()},
+    }
+
+
+def bench_save_stall() -> dict:
+    """Donation-safe async checkpoint snapshots (ISSUE 12): the step
+    loop's per-save stall, sync host fetch (async_snapshot=false) vs
+    async snapshot (true), measured from the journaled
+    ``save_stall_ms`` of real Trainer runs over interleaved repeats.
+
+    Gate, backend-dependent (the weak_scaling precedent — the claim is
+    about OUR save path, not the host):
+
+      * accelerators — async ≤ 0.5× the sync median: the sync fetch is
+        a blocking D2H transfer of the whole state, exactly what the
+        async device-side copy removes from the loop.
+      * CPU client — ``device_get`` is ZERO-COPY host views here (PJRT
+        copy-on-donate covers donation safety), so the sync fetch is
+        already nearly free and residual step-drain noise (shared by
+        both arms) swamps the 0.5× contrast (measured: medians within
+        ~10% either direction). The gated claim is that the async
+        machinery adds NO stall: async ≤ 1.0× sync + 1 ms.
+
+    Artifacts stay bitwise identical either way (pinned in
+    tests/test_async_checkpoint.py)."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    from distributedmnist_tpu.core.config import ExperimentConfig
+    from distributedmnist_tpu.obsv.report import load_jsonl
+    from distributedmnist_tpu.train.loop import Trainer
+
+    workdir = Path(tempfile.mkdtemp(prefix="dmt_save_stall_"))
+    n_repeats = 3
+    stalls: dict[str, list[float]] = {"sync_fetch": [], "async_snapshot": []}
+    try:
+        def one_run(tag: str, async_snapshot: bool, rep: int) -> list[float]:
+            d = workdir / f"{tag}_{rep}"
+            cfg = ExperimentConfig.from_dict({
+                "data": {"dataset": "synthetic", "batch_size": 64,
+                         "use_native_pipeline": False},
+                "model": {"compute_dtype": "float32"},
+                "optim": {"momentum": 0.9},
+                "parallel": {"shard_weight_update": True},
+                # log cadence == save cadence: the flush preceding each
+                # save drains the in-flight step, so the journaled stall
+                # isolates the SAVE machinery (host fetch + canonical
+                # conversion vs snapshot dispatch) from residual step
+                # execution, which both arms share
+                "train": {"max_steps": 8, "log_every_steps": 2,
+                          "save_interval_steps": 2,
+                          "save_results_period": 0,
+                          "train_dir": str(d),
+                          "async_checkpoint": True,
+                          "async_snapshot": async_snapshot}})
+            Trainer(cfg).run()
+            return [r["save_stall_ms"]
+                    for r in load_jsonl(d / "train_log.jsonl", "save")]
+
+        for rep in range(n_repeats):  # interleaved
+            stalls["sync_fetch"] += one_run("sync", False, rep)
+            stalls["async_snapshot"] += one_run("async", True, rep)
+
+        med = {k: statistics.median(v) for k, v in stalls.items()}
+        ratio = med["async_snapshot"] / med["sync_fetch"]
+        cpu = jax.default_backend() == "cpu"
+        if cpu:
+            passes = (med["async_snapshot"]
+                      <= med["sync_fetch"] * 1.0 + 1.0)
+            gate = ("cpu client: async-snapshot median save_stall_ms ≤ "
+                    "1.0× sync-fetch + 1 ms (zero-copy device_get makes "
+                    "the sync fetch ~free here; the gate holds the async "
+                    "path to adding no stall — the 0.5× D2H claim gates "
+                    "on accelerators)")
+        else:
+            passes = ratio <= 0.5
+            gate = ("accelerator: async-snapshot median save_stall_ms ≤ "
+                    "0.5× sync-fetch (the blocking D2H fetch leaves the "
+                    "step loop)")
+        return {
+            "metric": "save_stall",
+            "value": round(ratio, 3),
+            "unit": "x (async-snapshot/sync-fetch median save stall)",
+            "passes_gate": bool(passes),
+            "detail": {
+                "gate": (f"{gate}; {n_repeats} interleaved Trainer runs, "
+                         "stalls read from the journaled save events"),
+                "save_stall_ms_median": {k: round(v, 3)
+                                         for k, v in med.items()},
+                "save_stall_ms_all": {k: [round(x, 3) for x in v]
+                                      for k, v in stalls.items()},
+                "saves_per_arm": len(stalls["sync_fetch"]),
+                **_env_stamp()},
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_weak_scaling() -> dict:
     """Weak-scaling efficiency of the large-batch playbook (ROADMAP
     item 4, arXiv:1909.09756): images/sec at 1→2→4→8 devices with a
@@ -1291,6 +1543,7 @@ def main() -> None:
     for case in (bench_transformer_flash, bench_flash_long_context,
                  bench_mode_overhead, bench_native_loader,
                  bench_input_pipeline_overlap, bench_weight_update_sharding,
+                 bench_zero1_overlap, bench_save_stall,
                  bench_weak_scaling, bench_restart_latency,
                  bench_serving_latency):
         if not want(case):

@@ -261,10 +261,57 @@ class ParallelConfig:
     stay on their tensor-parallel placement (they are not replicated
     across THOSE axes; only their replica-axis redundancy would be
     addressable, and the flattened composite layout is not worth the
-    bookkeeping at this repo's scales)."""
+    bookkeeping at this repo's scales).
+
+    ``comm_buckets``: how many layer-ordered buckets the ZeRO-1
+    communication is split into (arXiv:1810.11112's overlap lever).
+    1 = the monolithic discipline: one collective per sharded leaf,
+    all issued after the full backward. N > 1 groups the sharded
+    leaves into N contiguous buckets balanced by padded size and
+    issues ONE reduce-scatter (and one allgather) per bucket — each
+    bucket's scatter depends only on its own leaves' gradients, so
+    XLA's scheduler can overlap a bucket's communication with the
+    remaining backward compute instead of serializing the whole comm
+    phase behind it. Bucketing is pure regrouping: the per-element
+    cross-replica sums are unchanged, so losses/params stay bitwise
+    equal to the monolithic path (pinned in tests/test_zero1.py).
+    Leave at 1 on CPU meshes, where collectives serialize on the host
+    and regrouping buys nothing (see README Performance).
+
+    ``resident_sharded``: keep the params THEMSELVES resident in the
+    replica-sharded flat layout between steps (the arXiv:2004.13336 §5
+    ending — a step toward ZeRO-3). Each step allgathers the weights
+    just-in-time per bucket at the top of the forward and the update
+    writes back only this replica's slice; peak per-chip param bytes
+    drop toward 1/n for the sharded leaves, and the post-update
+    allgather leaves the step entirely (the next forward's gather
+    replaces it). Checkpoints still store the canonical logical layout,
+    so artifacts (and their digests) are identical across this knob and
+    restore bitwise into any other layout. Requires
+    ``shard_weight_update`` (validated at build time)."""
 
     shard_weight_update: bool = False
     shard_min_leaf_size: int = 0
+    comm_buckets: int = 1
+    resident_sharded: bool = False
+
+    def validate(self) -> None:
+        """Build-time validation (called from ``zero1_plan_for``, which
+        every step/state builder routes through): a bad knob combo must
+        be a typed ConfigError naming the dependency at Trainer build,
+        not a shape error mid-step."""
+        if self.comm_buckets < 1:
+            raise ConfigError(
+                f"parallel.comm_buckets must be >= 1, got "
+                f"{self.comm_buckets} (1 = monolithic per-leaf "
+                "collectives, N > 1 = N layer-ordered overlap buckets)")
+        if self.resident_sharded and not self.shard_weight_update:
+            raise ConfigError(
+                "parallel.resident_sharded=true requires "
+                "parallel.shard_weight_update=true — resident-sharded "
+                "params are a layout of the ZeRO-1 shard plan; without "
+                "the sharded weight update there is no plan to shard "
+                "them by")
 
 
 @dataclass(frozen=True)
@@ -417,6 +464,17 @@ class TrainConfig:
     # Background-thread checkpoint writes (serialization + IO off the
     # hot loop); the final save always drains before run() returns.
     async_checkpoint: bool = True
+    # Donation-safe DEVICE-side snapshot for async saves: a cadence
+    # save dispatches an async copy of the state into fresh un-donated
+    # buffers (enqueued on the device queue BEFORE the next step's
+    # program, so the copy reads the buffers before donation reuses
+    # them) and the D2H fetch + canonical-layout conversion move to the
+    # checkpointer's worker thread — the step loop stalls only for the
+    # copy dispatch, journaled as save_stall_ms on every save event.
+    # Off: the historical sync fetch (state pulled to host in the train
+    # loop before the worker gets it). Ignored when async_checkpoint is
+    # off or the layout needs per-host sharded saves.
+    async_snapshot: bool = True
     resume: bool = True  # ≙ Supervisor restore-if-present (:262)
     profile_steps: tuple[int, int] = (0, 0)  # (start, stop) jax.profiler window
     # Recurring trace dumps: every N steps, capture a one-window trace

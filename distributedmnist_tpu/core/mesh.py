@@ -95,6 +95,31 @@ def gather_chunks_replicated(chunk, axis_name: str, full_len: int,
     return jax.lax.psum(buf, axis_name)
 
 
+def gather_bucket_replicated(chunk, axis_name: str, n: int) -> "jax.Array":
+    """Per-BUCKET variant of :func:`gather_chunks_replicated`: stack
+    each replica's 1-D concatenated bucket chunk (every sharded leaf's
+    ``[chunk]`` slice for one comm bucket, concatenated) into the
+    replicated ``[n, C]`` matrix whose row ``r`` is replica ``r``'s
+    contribution — ONE collective reassembles a whole bucket's params
+    instead of one per leaf (the bucketed ZeRO-1 allgather leg and the
+    resident-sharded just-in-time weight gather, parallel/api.py).
+    Column slices of the result recover each leaf's ``[n, chunk]``
+    view, which flattens row-major to exactly its padded ``[pad]``
+    layout.
+
+    Same shim split as the per-leaf helper: a plain ``all_gather``
+    under the jax-0.4.37 check_rep=False shim; on a replication-checked
+    jax each replica scatters its row into a zeros matrix and one psum
+    produces a statically-replicated result."""
+    if CHECK_REP_SHIM:
+        return jax.lax.all_gather(chunk, axis_name)  # [n, C]
+    import jax.numpy as jnp
+    buf = jnp.zeros((n,) + tuple(chunk.shape), chunk.dtype)
+    buf = jax.lax.dynamic_update_slice(
+        buf, chunk[None], (jax.lax.axis_index(axis_name), 0))
+    return jax.lax.psum(buf, axis_name)
+
+
 def initialize_distributed() -> None:
     """Multi-host bring-up (≙ tf.train.Server + startup barrier,
     src/mnist_distributed_train.py:27-35, src/timeout_manager.py:198-211).

@@ -72,16 +72,19 @@ _SHELL_PAYLOAD = ('i=$( [ -f ckpt ] && cat ckpt || echo 0 ); '
 # checkpoints), so a fully recovered trial must reproduce the
 # reference bitwise. {max_steps}/{save} templated from the config.
 # Runs a 2-replica simulated mesh with momentum and the ZeRO-1 sharded
-# weight update ON, so every campaign exercises replica-sharded
-# optimizer state end-to-end — kill/corrupt/resume must round-trip the
-# canonical checkpoint layout exactly, and invariant 3's opt-state
-# digest covers it instead of reporting vacuously on a stateless SGD.
+# weight update ON — with the comm split into 2 layer-ordered buckets
+# (parallel.comm_buckets, ISSUE 12) — so every campaign exercises
+# replica-sharded optimizer state AND the bucketed-overlap collectives
+# end-to-end: kill/corrupt/resume must round-trip the canonical
+# checkpoint layout exactly, and invariant 3's opt-state digest covers
+# it instead of reporting vacuously on a stateless SGD.
 _TRAIN_PAYLOAD = (
     "python -m distributedmnist_tpu.launch train "
     "train.train_dir=. data.dataset=synthetic data.batch_size=32 "
     "data.synthetic_train_size=256 data.synthetic_test_size=64 "
     "model.compute_dtype=float32 mesh.simulate_devices=2 "
     "optim.momentum=0.9 parallel.shard_weight_update=true "
+    "parallel.comm_buckets=2 "
     "train.max_steps={max_steps} "
     "train.log_every_steps=1 train.save_interval_steps={save} "
     "train.async_checkpoint=false train.save_results_period=0")

@@ -68,6 +68,12 @@ class StepTimeCollector:
         self._materialized = 0  # prefix of _raw already fetched to host
         self._host_steps: list[float] = []  # host-measured wall per step
         self._prefetch_depths: list[int] = []  # staged-queue gauge per step
+        # ZeRO-1 overlap gauges (set only when comm bucketing is on —
+        # the prefetch_queue_depth pattern: the report key exists iff
+        # the feature does): bucket structure + calibrated per-bucket
+        # comm time, plus the per-save snapshot stall series.
+        self._overlap: dict[str, Any] | None = None
+        self._snapshot_stalls: list[float] = []  # ms per save event
 
     def add(self, per_replica_times: Any, host_step_seconds: float | None = None,
             prefetch_depth: int | None = None) -> None:
@@ -106,6 +112,37 @@ class StepTimeCollector:
     def host_step_stats(self) -> CdfStats:
         return compute_stats(np.asarray(self._host_steps))
 
+    def set_overlap_info(self, bucket_count: int,
+                         per_bucket_pad_elems: list[int],
+                         per_bucket_comm_ms: list[float] | None = None
+                         ) -> None:
+        """Record the comm-overlap structure (``parallel.comm_buckets``
+        > 1): how many layer-ordered buckets the ZeRO-1 collectives are
+        grouped into, each bucket's padded element count, and — when a
+        calibration probe ran (Trainer.precompile) — the measured
+        per-bucket scatter+gather wall ms in isolation. Structural
+        gauges, not per-step measurements: inside one fused XLA program
+        the per-bucket comm time is not separately observable, so the
+        report carries the calibrated cost next to the live step
+        times instead of pretending to split them."""
+        self._overlap = {
+            "bucket_count": int(bucket_count),
+            "per_bucket_pad_elems": [int(x) for x in per_bucket_pad_elems],
+        }
+        if per_bucket_comm_ms is not None:
+            self._overlap["per_bucket_comm_ms"] = [
+                round(float(x), 3) for x in per_bucket_comm_ms]
+
+    def add_snapshot_stall_ms(self, ms: float) -> None:
+        """One checkpoint save's step-loop stall (train/loop.py _save):
+        the sync-fetch path pays host fetch + canonical conversion
+        here; the async-snapshot path only the device-copy dispatch."""
+        if len(self._snapshot_stalls) < self.capacity:
+            self._snapshot_stalls.append(float(ms))
+
+    def snapshot_stall_stats(self) -> CdfStats:
+        return compute_stats(np.asarray(self._snapshot_stalls, np.float64))
+
     def prefetch_depth_stats(self) -> CdfStats:
         """Distribution of the device-prefetch queue depth sampled at
         each step's dequeue: pinned at 0 means the producer (host
@@ -124,6 +161,16 @@ class StepTimeCollector:
         }
         if self._prefetch_depths:
             out["prefetch_queue_depth"] = self.prefetch_depth_stats().to_dict()
+        if self._overlap is not None:
+            overlap = dict(self._overlap)
+            if self._snapshot_stalls:
+                overlap["snapshot_stall_ms"] = (
+                    self.snapshot_stall_stats().to_dict())
+            out["overlap"] = overlap
+        elif self._snapshot_stalls:
+            # async snapshots pay off without bucketing too — the stall
+            # series stays visible when only that half is on
+            out["snapshot_stall_ms"] = self.snapshot_stall_stats().to_dict()
         return out
 
     def reset(self) -> None:
@@ -131,6 +178,7 @@ class StepTimeCollector:
         self._materialized = 0
         self._host_steps.clear()
         self._prefetch_depths.clear()
+        self._snapshot_stalls.clear()
 
 
 class ReplicaDeviceProbe:

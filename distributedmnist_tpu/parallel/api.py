@@ -37,8 +37,9 @@ from ..models.registry import Model, replicated_partition_rules
 from ..ops.drop_connect import drop_connect_grads
 from ..ops.masked_psum import contribution_scale, masked_mean_psum
 from . import policies
-from .partition_rules import (RuleAxes, Zero1Plan, match_partition_rules,
-                              make_zero1_plan, zero1_init_state, zero1_pack,
+from .partition_rules import (RuleAxes, Zero1Plan, comm_bucket_assignment,
+                              match_partition_rules, make_zero1_plan,
+                              zero1_init_state, zero1_pack,
                               zero1_state_specs, zero1_unpack)
 
 logger = get_logger("parallel")
@@ -175,6 +176,7 @@ def zero1_plan_for(model: Model, cfg: ExperimentConfig, topo: Topology,
     possible but not worth the extra state surface — documented
     fallback, see README Performance)."""
     par = cfg.parallel
+    par.validate()  # typed ConfigError at build time, not mid-step
     if not par.shard_weight_update:
         return None
     if topo.num_replicas <= 1 or cfg.sync.mode == "interval":
@@ -184,7 +186,9 @@ def zero1_plan_for(model: Model, cfg: ExperimentConfig, topo: Topology,
     pspecs = params_partition_specs(model, cfg, topo, params=params)
     return make_zero1_plan(params, pspecs, topo.replica_axis,
                            topo.num_replicas,
-                           min_leaf_size=par.shard_min_leaf_size)
+                           min_leaf_size=par.shard_min_leaf_size,
+                           comm_buckets=par.comm_buckets,
+                           params_sharded=par.resident_sharded)
 
 
 def resolved_param_dtype(cfg: ExperimentConfig):
@@ -216,7 +220,10 @@ def state_partition_specs(model: Model, cfg: ExperimentConfig,
     (tensor/pipeline/expert placements per the model's rule table), and
     — under ``parallel.shard_weight_update`` — optimizer moment slots
     split over the replica axis per the ZeRO-1 plan (every slot of a
-    multi-slot optimizer shards the same way)."""
+    multi-slot optimizer shards the same way). Under
+    ``parallel.resident_sharded`` the PARAMS take the same
+    replica-split flat placement as the slots — the plan is the single
+    source of truth for both layouts."""
     from jax.sharding import PartitionSpec as P_
     from ..train import optim as optim_lib
 
@@ -228,8 +235,10 @@ def state_partition_specs(model: Model, cfg: ExperimentConfig,
     slot_spec = (zero1_state_specs(plan, pspec) if plan is not None
                  else pspec)
     mspec = optim_lib.init_slots(opt, lambda: slot_spec)
+    param_spec = (slot_spec if plan is not None and plan.params_sharded
+                  else pspec)
     return TrainState(
-        params=pspec,
+        params=param_spec,
         momentum=mspec,
         step=P_(), updates_applied=P_(), root_key=P_(),
         window_acc=pspec if interval else None,
@@ -263,6 +272,11 @@ def init_train_state(model: Model, cfg: ExperimentConfig,
 
     momentum = optim_lib.init_slots(opt, one_slot_tree)
     interval = cfg.sync.mode == "interval"
+    if plan is not None and plan.params_sharded:
+        # resident-sharded layout: params live flattened-padded like
+        # the slots (host-side pack at init; the engine's padding is
+        # zeros by contract so the pack is exact)
+        params = zero1_pack(params, plan)
     return TrainState(
         params=params,
         momentum=momentum,
@@ -412,26 +426,39 @@ def canonical_save_state(state: TrainState,
     byte-stable across ``parallel.shard_weight_update`` settings and a
     sharded run's checkpoint restores onto a replicated config (and
     vice versa) with no migration. Multi-slot optimizer state (LAMB's
-    first/second moments) unpacks per slot, same contract. Host-side; a
-    no-op without a plan."""
+    first/second moments) unpacks per slot, same contract; under
+    ``parallel.resident_sharded`` the params unpack too — artifacts
+    carry logical params whatever layout the live state keeps them in,
+    so the path digest is identical across comm_buckets /
+    resident_sharded / shard_weight_update. Host-side; a no-op without
+    a plan."""
     from ..train import optim as optim_lib
-    if plan is None or state.momentum is None:
+    if plan is None:
         return state
-    return state.replace(momentum=optim_lib.map_slots(
-        lambda tree: zero1_unpack(tree, plan), state.momentum))
+    if state.momentum is not None:
+        state = state.replace(momentum=optim_lib.map_slots(
+            lambda tree: zero1_unpack(tree, plan), state.momentum))
+    if plan.params_sharded:
+        state = state.replace(params=zero1_unpack(state.params, plan))
+    return state
 
 
 def pack_restored_state(state: TrainState,
                         plan: Zero1Plan | None) -> TrainState:
     """Inverse of :func:`canonical_save_state` on the restore path:
-    fold canonically-saved (logical-shape) optimizer slots back into
-    the flattened-padded replica-shard layout the live state uses.
+    fold canonically-saved (logical-shape) optimizer slots — and, when
+    the plan keeps params resident-sharded, the params — back into the
+    flattened-padded replica-shard layout the live state uses.
     Exact — padding is zeros, truncation only ever removes padding."""
     from ..train import optim as optim_lib
-    if plan is None or state.momentum is None:
+    if plan is None:
         return state
-    return state.replace(momentum=optim_lib.map_slots(
-        lambda tree: zero1_pack(tree, plan), state.momentum))
+    if state.momentum is not None:
+        state = state.replace(momentum=optim_lib.map_slots(
+            lambda tree: zero1_pack(tree, plan), state.momentum))
+    if plan.params_sharded:
+        state = state.replace(params=zero1_pack(state.params, plan))
+    return state
 
 
 def _spec_norm_axes(spec) -> tuple[str, ...]:
@@ -519,6 +546,27 @@ def _zero1_update(params: Any, grads: Any, opt_state: Any,
     SGD scales lr by the applied flag; stateful optimizers — whose
     moments would decay — are select-guarded).
 
+    **Bucketed overlap** (``plan.comm_buckets > 1``, arXiv:1810.11112):
+    the sharded leaves' collectives are regrouped into layer-ordered
+    buckets (``partition_rules.comm_bucket_assignment``) — per bucket,
+    each leaf's padded gradient reshapes to ``[n, chunk]``, the rows
+    concatenate into one ``[n, C_b]`` matrix, and ONE ``psum_scatter``
+    hands this replica its concatenated chunk row; the allgather leg
+    reassembles per bucket the same way. A bucket's scatter depends
+    only on its own leaves' gradients, so the compiler can issue it
+    while earlier layers' backward still runs, and the per-collective
+    launch cost amortizes over the bucket. The per-ELEMENT cross-
+    replica sums are untouched by the regrouping — same addends, same
+    collective op, same dtype — so losses/params stay bitwise equal to
+    the monolithic path (pinned in tests/test_zero1.py).
+
+    **Resident-sharded params** (``plan.params_sharded``): the param
+    leaf arriving here IS this replica's ``[chunk]`` slice (the state
+    keeps the flat layout between steps), so the update skips both the
+    pre-update ``dynamic_slice`` and the post-update allgather — the
+    NEXT forward's just-in-time bucket gather replaces it
+    (:func:`_gather_resident_params`).
+
     Returns ``(new_params, new_opt_state, num_contributors, applied)``.
     """
     from ..train import optim as optim_lib
@@ -537,33 +585,65 @@ def _zero1_update(params: Any, grads: Any, opt_state: Any,
     # stateless sgd: lr·0 is exact, so scaling lr by the applied flag
     # IS the all-masked no-op (same trick as the replicated path)
     lr_eff = lr * applied.astype(jnp.float32) if stateless else lr
+    resident = plan.params_sharded
+    bucketed = plan.comm_buckets > 1
+    buckets = (comm_bucket_assignment(plan) if bucketed or resident
+               else [])
 
     def guard(new, old):
         return new if stateless else jnp.where(applied > 0, new, old)
 
+    gm_leaves = [g * scale.astype(g.dtype) for g in g_leaves]
+
+    # bucketed reduce-scatter: one collective per layer-ordered bucket,
+    # issued as soon as that bucket's gradients exist in the dataflow
+    gsh_by_leaf: dict[int, jax.Array] = {}
+    if bucketed:
+        for bucket in buckets:
+            rows = [_pad_flat(gm_leaves[i], lp_leaves[i])
+                    .reshape(plan.n, lp_leaves[i].chunk) for i in bucket]
+            scat = lax.psum_scatter(jnp.concatenate(rows, axis=1), axis,
+                                    scatter_dimension=0, tiled=True)[0]
+            off = 0
+            for i in bucket:
+                c = lp_leaves[i].chunk
+                gsh_by_leaf[i] = scat[off:off + c]
+                off += c
+
     new_p: list = []
     new_slots: list[list] = [[] for _ in in_slot_trees]
-    for i, (p, g, lp, spec) in enumerate(
-            zip(p_leaves, g_leaves, lp_leaves, spec_leaves)):
-        gm = g * scale.astype(g.dtype)
+    upd_chunks: dict[int, jax.Array] = {}  # bucketed gather leg inputs
+    for i, (p, gm, lp, spec) in enumerate(
+            zip(p_leaves, gm_leaves, lp_leaves, spec_leaves)):
         slots = tuple(sl[i] for sl in slot_leaves)
         adapt = len(lp.shape) > 1
         if lp.sharded:
-            # reduce-scatter: [pad] masked grads → this replica's
-            # summed [chunk] slice (already the mean via the pre-scale)
-            gsh = lax.psum_scatter(_pad_flat(gm, lp), axis,
-                                   scatter_dimension=0, tiled=True)
-            psh = lax.dynamic_slice(_pad_flat(p, lp), (me * lp.chunk,),
-                                    (lp.chunk,))
+            if bucketed:
+                gsh = gsh_by_leaf[i]
+            else:
+                # monolithic discipline: reduce-scatter per leaf —
+                # [pad] masked grads → this replica's summed [chunk]
+                # slice (already the mean via the pre-scale)
+                gsh = lax.psum_scatter(_pad_flat(gm, lp), axis,
+                                       scatter_dimension=0, tiled=True)
+            psh = (p if resident
+                   else lax.dynamic_slice(_pad_flat(p, lp),
+                                          (me * lp.chunk,), (lp.chunk,)))
             nps, nslots = opt.update_leaf(
                 psh, gsh, slots, lr_eff, t,
                 lambda x: lax.psum(x, axis), adapt)
             # select on the chunk — 1/n of the replicated guard cost
             nps = guard(nps, psh)
             nslots = tuple(guard(ns, s) for ns, s in zip(nslots, slots))
-            full = mesh_lib.gather_chunks_replicated(
-                nps, axis, lp.pad, me * lp.chunk)
-            new_p.append(full[:lp.size].reshape(lp.shape))
+            if resident:
+                new_p.append(nps)  # stays a chunk; next forward gathers
+            elif bucketed:
+                upd_chunks[i] = nps
+                new_p.append(None)  # filled by the bucket gather below
+            else:
+                full = mesh_lib.gather_chunks_replicated(
+                    nps, axis, lp.pad, me * lp.chunk)
+                new_p.append(full[:lp.size].reshape(lp.shape))
         else:
             mean = lax.psum(gm, axis)
             axes = _spec_norm_axes(spec)
@@ -575,10 +655,89 @@ def _zero1_update(params: Any, grads: Any, opt_state: Any,
             nslots = tuple(guard(ns, s) for ns, s in zip(nslots, slots))
         for j, s in enumerate(nslots):
             new_slots[j].append(s)
+    if bucketed and not resident:
+        # allgather leg, per bucket: one collective reassembles every
+        # leaf of the bucket; column slices of the replicated [n, C_b]
+        # recover each leaf's [n, chunk] view, whose row-major flatten
+        # IS its padded layout
+        for bucket in buckets:
+            cat = jnp.concatenate([upd_chunks[i] for i in bucket])
+            full = mesh_lib.gather_bucket_replicated(cat, axis, plan.n)
+            off = 0
+            for i in bucket:
+                lp = lp_leaves[i]
+                flat = full[:, off:off + lp.chunk].reshape(-1)
+                new_p[i] = flat[:lp.size].reshape(lp.shape)
+                off += lp.chunk
     params_out = jax.tree.unflatten(treedef, new_p)
     state_out = optim_lib.from_slot_trees(
         opt, [jax.tree.unflatten(treedef, sl) for sl in new_slots])
     return params_out, state_out, num, applied
+
+
+def _gather_resident_params(params: Any, plan: Zero1Plan,
+                            axis: str) -> Any:
+    """The just-in-time weight gather of the resident-sharded layout
+    (``parallel.resident_sharded``): reassemble full LOGICAL param
+    leaves from the per-replica flat chunks the state carries, one
+    collective per layer-ordered comm bucket — the next forward's
+    gather replacing the classic post-update allgather
+    (arXiv:2004.13336 §5). Runs inside shard_map on the chunk view;
+    fallback (unsharded) leaves pass through untouched."""
+    leaves, treedef = jax.tree.flatten(params)
+    lp_leaves = treedef.flatten_up_to(plan.leaf_plans)
+    out = list(leaves)
+    for bucket in comm_bucket_assignment(plan):
+        cat = jnp.concatenate([leaves[i] for i in bucket])
+        full = mesh_lib.gather_bucket_replicated(cat, axis, plan.n)
+        off = 0
+        for i in bucket:
+            lp = lp_leaves[i]
+            flat = full[:, off:off + lp.chunk].reshape(-1)
+            out[i] = flat[:lp.size].reshape(lp.shape)
+            off += lp.chunk
+    return jax.tree.unflatten(treedef, out)
+
+
+# jitted gather per (plan, mesh) — a fresh jax.jit wrapper per call
+# would miss the jit cache and recompile the gather on every
+# evaluate(). Keyed by id(plan) with the plan itself stored for the
+# identity check (its dict-structured leaf_plans make it unhashable);
+# the stored reference pins the plan, so ids can't be recycled under a
+# live entry — hence the size cap, which bounds what the cache keeps
+# alive across many short-lived Trainers.
+_logical_params_fns: dict[int, tuple] = {}
+
+
+def logical_params(state_params: Any, plan: Zero1Plan | None,
+                   topo: Topology) -> Any:
+    """A REPLICATED logical-layout view of possibly resident-sharded
+    live params — what in-process consumers that want the classic
+    layout (Trainer.evaluate feeding build_eval_step) call. A
+    passthrough without a resident plan; otherwise a jitted
+    truncate-and-reshape with replicated out_shardings (cached per
+    plan, so repeated evals pay a gather, not a recompile), working on
+    multi-host meshes too (checkpoint consumers never need this —
+    artifacts already store the canonical logical layout)."""
+    if plan is None or not plan.params_sharded:
+        return state_params
+    from jax.sharding import NamedSharding
+    cached = _logical_params_fns.get(id(plan))
+    if cached is None or cached[0] is not plan or cached[1] is not topo.mesh:
+
+        def unpack(tree):
+            return jax.tree.map(
+                lambda x, lp: (x[:lp.size].reshape(lp.shape) if lp.sharded
+                               else x),
+                tree, plan.leaf_plans)
+
+        if len(_logical_params_fns) >= 32:
+            _logical_params_fns.clear()
+        cached = (plan, topo.mesh,
+                  jax.jit(unpack,
+                          out_shardings=NamedSharding(topo.mesh, P())))
+        _logical_params_fns[id(plan)] = cached
+    return cached[2](state_params)
 
 
 def _gather_replicated(x: jax.Array, axis: str, n: int) -> jax.Array:
@@ -593,6 +752,45 @@ def _gather_replicated(x: jax.Array, axis: str, n: int) -> jax.Array:
     me = lax.axis_index(axis)
     onehot = (jnp.arange(n) == me).astype(x.dtype)
     return lax.psum(onehot * x, axis)
+
+
+def measure_bucket_comm_ms(topo: Topology, plan: Zero1Plan,
+                           repeats: int = 3) -> list[float]:
+    """Calibrate each comm bucket's scatter+gather wall ms in
+    isolation (median of ``repeats`` timed runs of a tiny jitted
+    program per bucket) — the per-bucket comm gauge the timing report
+    surfaces when overlap is on (obsv/timing.py). Inside the fused
+    train step the per-bucket comm time is not separately observable;
+    this measures the same collectives on zeros of the same shapes.
+    One small compile per bucket — call from precompile, not per
+    step."""
+    import statistics
+    import time as _time
+    axis = topo.replica_axis
+    n = plan.n
+    lps = jax.tree.leaves(plan.leaf_plans,
+                          is_leaf=lambda x: hasattr(x, "sharded"))
+    out: list[float] = []
+    for bucket in comm_bucket_assignment(plan):
+        c_b = sum(lps[i].chunk for i in bucket)
+
+        def probe(x):
+            s = lax.psum_scatter(x, axis, scatter_dimension=0,
+                                 tiled=True)[0]
+            g = mesh_lib.gather_bucket_replicated(s, axis, n)
+            return g.sum()
+
+        fn = jax.jit(mesh_lib.shard_map(probe, mesh=topo.mesh,
+                                        in_specs=P(), out_specs=P()))
+        x = jnp.zeros((n, c_b), jnp.float32)
+        float(fn(x))  # compile + warm
+        times = []
+        for _ in range(max(1, repeats)):
+            t0 = _time.perf_counter()
+            float(fn(x))
+            times.append((_time.perf_counter() - t0) * 1e3)
+        out.append(statistics.median(times))
+    return out
 
 
 def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
@@ -733,9 +931,11 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
     # already device-varying there)
     grad_axes = (axis, seq_ax) if n_seq > 1 else (axis,)
     state_specs = state_partition_specs(model, cfg, topo)
-    # per-leaf param placements — what the trust-ratio norm reductions
-    # complete partial sums over for non-replica-sharded leaves
-    pspec_tree = state_specs.params
+    # per-leaf LOGICAL param placements — what the trust-ratio norm
+    # reductions complete partial sums over for non-replica-sharded
+    # leaves (NOT state_specs.params, which under resident_sharded
+    # carries the flat replica-split layout instead)
+    pspec_tree = params_partition_specs(model, cfg, topo)
     # ZeRO-1 (parallel.shard_weight_update): reduce-scatter grads,
     # update only this replica's param/momentum slice, allgather fresh
     # params — per the engine's shard plan, which state_partition_specs
@@ -834,8 +1034,16 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         # the raw per-shard gradient — masks must apply BEFORE the
         # replica aggregation, and the seq-axis psum must be explicit —
         # so cast params to varying over every grad axis first.
+        # Resident-sharded layout: the state carries per-replica flat
+        # chunks; the just-in-time bucket gather reassembles the full
+        # logical weights HERE — in the next step's forward — instead
+        # of the update's trailing allgather (arXiv:2004.13336 §5).
+        fwd_source = (state.params
+                      if z_plan is None or not z_plan.params_sharded
+                      else _gather_resident_params(state.params, z_plan,
+                                                   axis))
         local_params = jax.tree.map(
-            lambda x: lax.pcast(x, grad_axes, to="varying"), state.params)
+            lambda x: lax.pcast(x, grad_axes, to="varying"), fwd_source)
         # master weights: the forward sees the derived param_dtype view
         fwd_params = fwd_view(local_params)
 

@@ -139,11 +139,23 @@ class LeafShardPlan:
 @dataclasses.dataclass(frozen=True)
 class Zero1Plan:
     """The whole-tree plan: ``leaf_plans`` mirrors the param treedef
-    with a :class:`LeafShardPlan` per leaf."""
+    with a :class:`LeafShardPlan` per leaf.
+
+    ``comm_buckets`` is the requested number of layer-ordered
+    communication buckets (1 = the monolithic per-leaf discipline;
+    the effective count is clamped to the sharded-leaf count —
+    :func:`comm_bucket_assignment`). ``params_sharded`` marks the
+    resident-sharded layout (``parallel.resident_sharded``): the
+    PARAMS live flattened-padded per this plan between steps, exactly
+    like the optimizer slots, and every consumer of the canonical
+    checkpoint contract (pack/unpack, state specs, init) reads that
+    decision from here — one source of truth, same as the padding."""
 
     axis: str          # the replica mesh axis
     n: int             # replica count
     leaf_plans: Any
+    comm_buckets: int = 1
+    params_sharded: bool = False
 
     @property
     def any_sharded(self) -> bool:
@@ -152,7 +164,8 @@ class Zero1Plan:
 
 
 def make_zero1_plan(params: Any, param_specs: Any, axis: str, n: int,
-                    min_leaf_size: int = 0) -> Zero1Plan:
+                    min_leaf_size: int = 0, comm_buckets: int = 1,
+                    params_sharded: bool = False) -> Zero1Plan:
     """Decide, per leaf, whether the optimizer state / weight update
     shards over ``axis`` (``n`` replicas). ``params`` may be abstract
     (``jax.eval_shape`` output). ``min_leaf_size``: smallest element
@@ -169,7 +182,48 @@ def make_zero1_plan(params: Any, param_specs: Any, axis: str, n: int,
                              chunk=chunk, shape=shape)
 
     return Zero1Plan(axis=axis, n=n,
-                     leaf_plans=jax.tree.map(leaf_plan, params, param_specs))
+                     leaf_plans=jax.tree.map(leaf_plan, params, param_specs),
+                     comm_buckets=max(1, int(comm_buckets)),
+                     params_sharded=bool(params_sharded))
+
+
+def comm_bucket_assignment(plan: Zero1Plan) -> list[list[int]]:
+    """The layer-ordered communication buckets: a partition of the
+    SHARDED leaves' flatten indices into ``plan.comm_buckets``
+    contiguous groups balanced by padded element count.
+
+    Flatten order is the model's layer order (param trees flatten
+    depth-first by layer), so a bucket's gradients complete together
+    in the backward sweep and its reduce-scatter can issue while
+    earlier layers' backward is still running — the overlap schedule.
+    Contiguity + the size-balanced boundary rule make the assignment a
+    pure function of (plan, comm_buckets): every consumer (update
+    kernel, resident-param gather, the comm-calibration probe) derives
+    the identical grouping, so the scattered/gathered concatenation
+    layouts can never drift. Effective bucket count is clamped to the
+    sharded-leaf count; empty when nothing shards."""
+    lps = jax.tree.leaves(plan.leaf_plans,
+                          is_leaf=lambda x: isinstance(x, LeafShardPlan))
+    sharded = [i for i, lp in enumerate(lps) if lp.sharded]
+    if not sharded:
+        return []
+    k = max(1, min(int(plan.comm_buckets), len(sharded)))
+    total = float(sum(lps[i].pad for i in sharded))
+    buckets: list[list[int]] = [[] for _ in range(k)]
+    cum, b = 0.0, 0
+    for pos, i in enumerate(sharded):
+        # advance to the bucket this leaf's start falls in (size
+        # boundary), or when the remaining leaves are only just enough
+        # to keep every remaining bucket non-empty (a dominant leaf
+        # must not starve the tail buckets) — never past the last
+        # bucket, never leaving an earlier one empty
+        while (b < k - 1 and buckets[b]
+               and (cum >= (b + 1) * total / k
+                    or len(sharded) - pos <= k - b - 1)):
+            b += 1
+        buckets[b].append(i)
+        cum += lps[i].pad
+    return buckets
 
 
 def zero1_state_specs(plan: Zero1Plan, param_specs: Any) -> Any:

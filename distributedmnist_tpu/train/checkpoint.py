@@ -201,6 +201,60 @@ def snapshot_for_save(state: Any):
     return ("sharded", local, meta)
 
 
+class _LeafViews:
+    """Zero-copy per-shard host views of ONE device array — the CPU
+    client's donation-safe snapshot primitive. ``np.asarray`` of a
+    single-device shard is a host view (no copy), and PJRT's
+    copy-on-donate protects any buffer with a live external reference,
+    so the views keep their pre-donation values after the next step
+    donates the state (verified on jaxlib 0.4.37). Crucially the
+    CROSS-SHARD ASSEMBLY of replica-split (ZeRO-1) leaves — the real
+    per-save cost — is deferred to :meth:`materialize` on the
+    checkpoint worker thread instead of the train loop."""
+
+    __slots__ = ("shape", "dtype", "slabs")
+
+    def __init__(self, x: "jax.Array"):
+        self.shape, self.dtype = tuple(x.shape), x.dtype
+        self.slabs: list = []
+        seen = set()
+        for sh in x.addressable_shards:
+            idx = tuple(sl.indices(dim)[:2]
+                        for sl, dim in zip(sh.index, x.shape))
+            if idx in seen:  # replicas of the same slab: keep one
+                continue
+            seen.add(idx)
+            self.slabs.append((idx, np.asarray(sh.data)))
+
+    def materialize(self) -> np.ndarray:
+        if len(self.slabs) == 1 and self.slabs[0][1].shape == self.shape:
+            return self.slabs[0][1]
+        buf = np.empty(self.shape, self.dtype)
+        for idx, data in self.slabs:
+            buf[tuple(slice(a, b) for a, b in idx)] = data
+        return buf
+
+
+def host_view_snapshot(state: Any) -> Any:
+    """Snapshot ``state`` as per-shard host views (:class:`_LeafViews`
+    per jax leaf; other leaves pass through) — near-zero cost on the
+    train loop. Pair with :func:`materialize_snapshot` on the worker.
+    CPU-client only: on accelerators ``np.asarray(shard.data)`` is a
+    blocking D2H transfer, exactly the stall this exists to avoid —
+    those backends snapshot via an async on-device copy instead
+    (train/loop.py)."""
+    return jax.tree.map(
+        lambda x: _LeafViews(x) if isinstance(x, jax.Array) else x, state)
+
+
+def materialize_snapshot(tree: Any) -> Any:
+    """Assemble a :func:`host_view_snapshot` back into plain numpy
+    leaves (the worker-thread half)."""
+    return jax.tree.map(
+        lambda x: x.materialize() if isinstance(x, _LeafViews) else x,
+        tree, is_leaf=lambda x: isinstance(x, _LeafViews))
+
+
 def _digest_path(path: Path) -> Path:
     return path.with_suffix(path.suffix + _DIGEST_SUFFIX)
 
@@ -338,13 +392,25 @@ class AsyncCheckpointer:
     The reference's Supervisor saves synchronously from its own timer
     thread (src/distributed_train.py:244-252); here the *train loop*
     triggers saves, so serialization + file IO must not stall the step
-    cadence. ``save`` fetches state to host synchronously (the step
-    function donates its input buffers, so a background device read
-    would race with donation) and hands the numpy pytree to a worker
-    that msgpacks and writes it. Latest-wins: if a save is still in
-    flight when the next one arrives, the pending one is replaced —
-    checkpoints are snapshots, not a journal. Worker errors surface on
-    the next ``save``/``wait``.
+    cadence. By default ``save`` fetches state to host synchronously
+    (the step function donates its input buffers, so a background
+    device read of the LIVE state would race with donation) and hands
+    the numpy pytree to a worker that msgpacks and writes it.
+
+    **Donation-safe device snapshots** (``prepare=``): a caller that
+    has already copied the state into fresh un-donated device buffers
+    (train/loop.py dispatches that copy right after the step, BEFORE
+    the next step's program is enqueued — so the copy reads the
+    donated buffers first) passes the device-array pytree plus a
+    ``prepare`` callable; the WORKER thread runs ``prepare`` (D2H
+    fetch + canonical-layout conversion) before writing, and the train
+    loop's stall shrinks to the copy dispatch. A ``prepare`` failure
+    counts as a failed write (logged + surfaced on ``wait``), same as
+    an IO error.
+
+    Latest-wins: if a save is still in flight when the next one
+    arrives, the pending one is replaced — checkpoints are snapshots,
+    not a journal. Worker errors surface on the next ``save``/``wait``.
     """
 
     def __init__(self, max_consecutive_failures: int = 3):
@@ -373,7 +439,12 @@ class AsyncCheckpointer:
                 self._pending = None
                 self._busy = True
             try:
-                save_checkpoint(*job)
+                *args, prepare = job
+                if prepare is not None:
+                    # device snapshot → host + canonical layout, off
+                    # the train loop's critical path
+                    args[1] = prepare(args[1])
+                save_checkpoint(*args)
             except Exception as e:
                 # Log NOW (the failure may otherwise go unnoticed for
                 # hours of training); also kept for wait() to raise.
@@ -400,7 +471,8 @@ class AsyncCheckpointer:
 
     def save(self, train_dir: str | Path, state: Any, step: int,
              extra: dict | None = None, keep: int = 5,
-             no_skip: bool = False) -> None:
+             no_skip: bool = False,
+             prepare: Callable[[Any], Any] | None = None) -> None:
         """Queue a write. A single failed write never raises here —
         that already went to the log and a later save may well succeed
         (transient disk pressure); ``wait`` raises if the LAST write
@@ -412,16 +484,24 @@ class AsyncCheckpointer:
         ``no_skip``: drain a lagging queued write instead of replacing
         it — the per-host sharded layout needs EVERY process to write
         EVERY triggered step, or a process that skipped a different
-        step than its siblings would leave that checkpoint torn."""
+        step than its siblings would leave that checkpoint torn.
+
+        ``prepare``: defer the host snapshot to the worker thread (the
+        donation-safe device-snapshot path, class docstring) — the
+        caller must pass buffers the step will NOT donate (a fresh
+        device copy)."""
         with self._lock:
             if self._consecutive_failures >= self.max_consecutive_failures:
                 raise RuntimeError(
                     f"{self._consecutive_failures} consecutive async "
                     "checkpoint writes failed; giving up"
                 ) from self._last_failure
-        # sync snapshot: buffers get donated next step (sharded states
-        # snapshot their addressable shards the same way)
-        host_state = snapshot_for_save(state)
+        if prepare is None:
+            # sync snapshot: buffers get donated next step (sharded
+            # states snapshot their addressable shards the same way)
+            host_state = snapshot_for_save(state)
+        else:
+            host_state = state  # un-donated device copy; worker fetches
         if no_skip:
             with self._wake:
                 while self._pending is not None and not self.closed:
@@ -432,7 +512,8 @@ class AsyncCheckpointer:
             if self._pending is not None:
                 logger.warning("checkpoint writer lagging; replacing queued "
                                "step=%d with step=%d", self._pending[2], step)
-            self._pending = (train_dir, host_state, step, extra, keep)
+            self._pending = (train_dir, host_state, step, extra, keep,
+                             prepare)
             self._wake.notify_all()
 
     def wait(self) -> None:

@@ -32,8 +32,9 @@ from ..models.registry import Model, get_model
 from ..obsv.timing import StepTimeCollector
 from ..parallel.api import (TrainState, build_eval_step, build_train_step,
                             canonical_save_state, init_train_state,
-                            restore_for_topology, state_partition_specs,
-                            world_signature, zero1_plan_for)
+                            logical_params, restore_for_topology,
+                            state_partition_specs, world_signature,
+                            zero1_plan_for)
 from . import checkpoint as ckpt
 from .lr_schedule import (constant, decay_steps_for, exponential_decay,
                           warmup_polynomial_decay)
@@ -173,6 +174,29 @@ class Trainer:
                                 else None)
 
         self.collector = StepTimeCollector(num_replicas=n)
+        # comm-overlap gauges (parallel.comm_buckets > 1): the bucket
+        # structure is known at build; the per-bucket comm calibration
+        # joins in precompile() (obsv/timing.py set_overlap_info)
+        self._comm_buckets = None
+        self._bucket_pad_elems = None
+        if (self._zero1_plan is not None
+                and self._zero1_plan.comm_buckets > 1):
+            from ..parallel.partition_rules import comm_bucket_assignment
+            buckets = comm_bucket_assignment(self._zero1_plan)
+            # empty when no leaf actually shards (e.g. a high
+            # shard_min_leaf_size) — then bucketing is NOT active and
+            # the overlap report key must not appear
+            if buckets:
+                lps = jax.tree.leaves(
+                    self._zero1_plan.leaf_plans,
+                    is_leaf=lambda x: hasattr(x, "sharded"))
+                self._comm_buckets = buckets
+                # derived once; precompile() re-reports with the
+                # calibrated per-bucket comm ms added
+                self._bucket_pad_elems = [sum(lps[i].pad for i in b)
+                                          for b in buckets]
+                self.collector.set_overlap_info(len(buckets),
+                                                self._bucket_pad_elems)
         # Test/fault-injection seam: extra per-LOCAL-replica delay (ms)
         # added onto the measured vector — lets tests (and chaos runs)
         # make a specific replica the straggler deterministically.
@@ -209,6 +233,17 @@ class Trainer:
                 "checkpoint cadence every process agrees on: set "
                 "train.save_interval_steps (and save_interval_secs=0)")
         self._checkpointer: ckpt.AsyncCheckpointer | None = None
+        # Donation-safe async snapshot (train.async_snapshot): cadence
+        # saves dispatch an async device copy into fresh un-donated
+        # buffers — enqueued before the next step's program, so the
+        # copy reads the state before donation reuses it — and the D2H
+        # fetch + canonical conversion run on the checkpointer's worker
+        # thread. Single-file layouts only: the per-host sharded format
+        # needs every process's synchronized snapshot semantics as-is.
+        self._async_snapshot = (self._use_async_ckpt
+                                and cfg.train.async_snapshot
+                                and not self._sharded_ckpt)
+        self._snapshot_fn = None  # jitted un-donated copy, built lazily
         self._sink: JsonlSink | None = None
         # Structured recovery events (NaN rollbacks, corrupt-checkpoint
         # fallbacks, preemption flushes) — the trainer-side half of the
@@ -328,6 +363,7 @@ class Trainer:
         # writes the classic single file alone.
         if not self.is_writer and not ckpt.state_needs_sharded_save(self.state):
             return
+        t0 = time.perf_counter()
         # the world the artifact is saved under: what lets a restore
         # tell "same world" from "resized world, reshard" and the
         # supervisor name both sides of an elastic reconfigure
@@ -340,27 +376,77 @@ class Trainer:
         if callable(iter_state) and getattr(self.train_feed, "has_state", True):
             extra["data_iter"] = self.train_feed.state()
         at_step = int(jax.device_get(self.state.step))
-        # canonical layout on disk: replica-sharded (ZeRO-1) momentum is
-        # unpacked to its logical shapes so the artifact — and its
-        # canonical path digest — is identical to a replicated run's.
-        # Only when this process can materialize the buffers (always
-        # true single-process); a cross-process sharded layout saves
-        # its live layout via the per-host shard format instead.
-        state_to_save = self.state
-        if (self._zero1_plan is not None
-                and not ckpt.state_needs_sharded_save(self.state)):
-            state_to_save = canonical_save_state(self.state, self._zero1_plan)
-        if self._use_async_ckpt:
+        if self._async_snapshot:
+            # donation-safe snapshot, backend-matched (both variants
+            # leave the canonical-layout conversion + the state-dict
+            # walk + serialization to the worker thread):
+            #   * CPU client — host VIEWS via device_get: PJRT
+            #     copy-on-donate protects buffers with live external
+            #     references, so the views keep their pre-donation
+            #     values (verified on jaxlib 0.4.37), and the grab is
+            #     ~free where a device-side copy would execute a
+            #     SYNCHRONOUS memcpy at dispatch (measured ~10 ms for
+            #     the flagship CNN state).
+            #   * accelerators — an async on-device copy into fresh
+            #     un-donated buffers, enqueued ahead of the next
+            #     step's donating program (so the copy reads the
+            #     buffers first); device_get here would be the
+            #     blocking D2H stall this knob exists to remove.
             if self._checkpointer is None or self._checkpointer.closed:
                 self._checkpointer = ckpt.AsyncCheckpointer()
-            self._checkpointer.save(self.train_dir, state_to_save, at_step,
-                                    extra=extra,
-                                    keep=self.cfg.train.keep_checkpoints,
-                                    no_skip=self._sharded_ckpt)
+            plan = self._zero1_plan
+            if jax.default_backend() == "cpu":
+                snap = ckpt.host_view_snapshot(self.state)
+                prepare = (lambda s: ckpt.snapshot_for_save(
+                    canonical_save_state(ckpt.materialize_snapshot(s),
+                                         plan)))
+            else:
+                if self._snapshot_fn is None:
+                    import jax.numpy as jnp
+                    self._snapshot_fn = jax.jit(
+                        lambda s: jax.tree.map(jnp.copy, s))
+                snap = self._snapshot_fn(self.state)
+                prepare = (lambda s: ckpt.snapshot_for_save(
+                    canonical_save_state(s, plan)))
+            self._checkpointer.save(
+                self.train_dir, snap, at_step, extra=extra,
+                keep=self.cfg.train.keep_checkpoints, prepare=prepare)
         else:
-            ckpt.save_checkpoint(self.train_dir, state_to_save, at_step,
-                                 extra=extra,
-                                 keep=self.cfg.train.keep_checkpoints)
+            # canonical layout on disk: replica-sharded (ZeRO-1)
+            # momentum — and resident-sharded params — unpack to their
+            # logical shapes so the artifact (and its canonical path
+            # digest) is identical to a replicated run's. Only when
+            # this process can materialize the buffers (always true
+            # single-process); a cross-process sharded layout saves
+            # its live layout via the per-host shard format instead.
+            state_to_save = self.state
+            if (self._zero1_plan is not None
+                    and not ckpt.state_needs_sharded_save(self.state)):
+                state_to_save = canonical_save_state(self.state,
+                                                     self._zero1_plan)
+            if self._use_async_ckpt:
+                if self._checkpointer is None or self._checkpointer.closed:
+                    self._checkpointer = ckpt.AsyncCheckpointer()
+                self._checkpointer.save(self.train_dir, state_to_save,
+                                        at_step, extra=extra,
+                                        keep=self.cfg.train.keep_checkpoints,
+                                        no_skip=self._sharded_ckpt)
+            else:
+                ckpt.save_checkpoint(self.train_dir, state_to_save, at_step,
+                                     extra=extra,
+                                     keep=self.cfg.train.keep_checkpoints)
+        # what the step loop actually paid for this save — the quantity
+        # the save_stall bench gates (async-snapshot dispatch vs the
+        # sync host fetch + canonical conversion)
+        stall_ms = (time.perf_counter() - t0) * 1e3
+        self.collector.add_snapshot_stall_ms(stall_ms)
+        # "at_step", deliberately NOT "step": the log-tail parsers
+        # (launch/cluster.py parse_poll_output and the resume watch)
+        # treat any intact record carrying "step" as training progress
+        self._sink_write({"event": "save", "time": time.time(),
+                          "at_step": at_step,
+                          "save_stall_ms": round(stall_ms, 3),
+                          "async_snapshot": self._async_snapshot})
         self._last_save_time = time.time()
 
     def _rollback_to_last_good(self, err: _NonFiniteLoss) -> int:
@@ -504,6 +590,17 @@ class Trainer:
                 "misses": after["misses"] - before["misses"]}
         logger.info("precompiled train step in %.2fs (source=%s)",
                     info["compile_s"], info["source"])
+        if self._comm_buckets:
+            # per-bucket comm calibration (small probe compiles — only
+            # when overlap is on, and never fatal to the fast path)
+            try:
+                from ..parallel.api import measure_bucket_comm_ms
+                self.collector.set_overlap_info(
+                    len(self._comm_buckets), self._bucket_pad_elems,
+                    measure_bucket_comm_ms(self.topo, self._zero1_plan))
+            except Exception as e:
+                logger.warning("bucket comm calibration failed (%s: %s)",
+                               type(e).__name__, e)
         self._compile_info = info
         return info
 
@@ -536,9 +633,14 @@ class Trainer:
 
     def evaluate(self, split: str = "test") -> dict[str, float]:
         """One full-split eval pass (in-loop convenience; the
-        continuous evaluator lives in ``evalsvc``)."""
+        continuous evaluator lives in ``evalsvc``). Resident-sharded
+        params are gathered to the logical replicated layout the eval
+        step places (parallel.api.logical_params — a passthrough
+        otherwise)."""
         return run_full_eval(
-            self.eval_fn, self.state.params, self.topo,
+            self.eval_fn,
+            logical_params(self.state.params, self._zero1_plan, self.topo),
+            self.topo,
             getattr(self.datasets, split), self.cfg.eval.eval_batch_size,
             prefetch_depth=self.cfg.data.effective_device_prefetch_depth())
 
@@ -842,8 +944,16 @@ class Trainer:
             # same-seed reference by this — and against the final
             # checkpoint's own digest (the two must agree). None when
             # shards live on other processes (this process cannot
-            # materialize the full params to hash them).
-            "params_digest": (ckpt.state_params_digest(self.state)
+            # materialize the full params to hash them). Canonicalized
+            # first: a resident-sharded run must hash the same LOGICAL
+            # params a replicated same-seed run does — with momentum
+            # dropped before the conversion, since the digest reads
+            # params only and unpacking whole moment trees for it
+            # would be a wasted D2H fetch.
+            "params_digest": (ckpt.state_params_digest(
+                                  canonical_save_state(
+                                      self.state.replace(momentum=None),
+                                      self._zero1_plan))
                               if not self._sharded_ckpt else None),
             "timing": self.collector.report(),
             # self-healing outcome: None/0 on a clean run; the CLI maps

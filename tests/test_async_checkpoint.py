@@ -72,6 +72,78 @@ def test_trainer_async_checkpoint_resume(tmp_train_dir):
     assert tr2.run()["final_step"] == 8
 
 
+def test_prepare_runs_on_worker_thread_and_fails_like_a_write(tmp_path):
+    """The donation-safe snapshot seam: ``prepare`` (D2H + canonical
+    conversion) executes on the WORKER thread — never the caller's —
+    and a prepare failure surfaces exactly like a failed write."""
+    import threading
+
+    caller = threading.current_thread().name
+    seen: list[str] = []
+
+    def prepare(state):
+        seen.append(threading.current_thread().name)
+        return {"w": state["w"] * 2}
+
+    ac = ckpt.AsyncCheckpointer()
+    ac.save(tmp_path, {"w": np.arange(4.0)}, 3, prepare=prepare)
+    ac.wait()
+    assert seen and seen[0] != caller  # ran on ckpt-writer, not here
+    got = ckpt.restore_checkpoint(tmp_path, {"w": np.zeros(4)})
+    np.testing.assert_array_equal(got[0]["w"], np.arange(4.0) * 2)
+
+    def bad_prepare(state):
+        raise ValueError("snapshot conversion exploded")
+
+    ac.save(tmp_path, {"w": np.arange(4.0)}, 4, prepare=bad_prepare)
+    with pytest.raises(RuntimeError, match="async checkpoint"):
+        ac.wait()
+    ac.close()
+    assert ckpt.latest_checkpoint_step(tmp_path) == 3  # step 4 never landed
+
+
+def test_trainer_async_snapshot_journals_save_stall(tmp_train_dir):
+    """train.async_snapshot (the default): every save lands a
+    journaled ``event: "save"`` with save_stall_ms, the timing report
+    carries the snapshot_stall_ms stats, and the artifact roundtrips
+    bitwise against a sync-fetch (async_snapshot=false) run."""
+    import json
+    from pathlib import Path
+
+    from distributedmnist_tpu.train.loop import Trainer
+
+    def run(d, async_snapshot):
+        cfg = base_config(
+            optim={"momentum": 0.9},
+            parallel={"shard_weight_update": True},
+            train={"max_steps": 4, "train_dir": d, "log_every_steps": 2,
+                   "save_interval_secs": 0, "save_interval_steps": 2,
+                   "save_results_period": 0, "async_checkpoint": True,
+                   "async_snapshot": async_snapshot})
+        t = Trainer(cfg)
+        assert t._async_snapshot is async_snapshot
+        return t.run()
+
+    d_async = tmp_train_dir + "_a"
+    d_sync = tmp_train_dir + "_s"
+    sa = run(d_async, True)
+    ss = run(d_sync, False)
+    # identical artifacts either way — the snapshot path is a latency
+    # change, not a numerics one
+    assert (ckpt.checkpoint_params_digest(d_async)
+            == ckpt.checkpoint_params_digest(d_sync))
+    assert (ckpt.checkpoint_opt_state_digest(d_async)
+            == ckpt.checkpoint_opt_state_digest(d_sync))
+    for d, flag, summary in ((d_async, True, sa), (d_sync, False, ss)):
+        recs = [json.loads(l) for l in
+                (Path(d) / "train_log.jsonl").read_text().splitlines()]
+        saves = [r for r in recs if r.get("event") == "save"]
+        assert saves, "no save events journaled"
+        assert all(r["async_snapshot"] is flag and r["save_stall_ms"] >= 0
+                   for r in saves)
+        assert summary["timing"]["snapshot_stall_ms"]["count"] == len(saves)
+
+
 def test_save_escalates_after_consecutive_failures(tmp_path):
     # A file where the checkpoint *directory* should be makes every
     # write fail the same way a persistently broken disk would.

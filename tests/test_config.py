@@ -68,3 +68,33 @@ def test_save_load(tmp_path):
     p = tmp_path / "out.json"
     cfg.save(p)
     assert ExperimentConfig.from_file(p) == cfg
+
+
+def test_parallel_knob_validation_is_typed_and_build_time():
+    """ISSUE 12 satellite: comm_buckets < 1 and resident_sharded
+    without shard_weight_update are typed ConfigErrors naming the knob
+    / dependency, raised at BUILD time (zero1_plan_for — every
+    state/step builder routes through it), never a shape error
+    mid-step."""
+    from distributedmnist_tpu.core.config import ParallelConfig
+
+    with pytest.raises(ConfigError, match="comm_buckets"):
+        ParallelConfig(comm_buckets=0).validate()
+    with pytest.raises(ConfigError, match="shard_weight_update"):
+        ParallelConfig(resident_sharded=True).validate()
+    # the valid combos pass
+    ParallelConfig().validate()
+    ParallelConfig(shard_weight_update=True, comm_buckets=4,
+                   resident_sharded=True).validate()
+
+    # and the build path actually hits it: zero1_plan_for validates
+    # FIRST, so even a config whose plan would be None (no sharding)
+    # refuses the orphaned resident_sharded knob
+    from distributedmnist_tpu.core.mesh import make_topology
+    from distributedmnist_tpu.models.registry import get_model
+    from distributedmnist_tpu.parallel.api import zero1_plan_for
+    cfg = ExperimentConfig.from_dict(
+        {"model": {"compute_dtype": "float32"},
+         "parallel": {"resident_sharded": True}})
+    with pytest.raises(ConfigError, match="shard_weight_update"):
+        zero1_plan_for(get_model(cfg.model), cfg, make_topology())

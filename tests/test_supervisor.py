@@ -91,23 +91,90 @@ def test_supervisor_restarts_killed_worker_resumes_from_checkpoint(tmp_path):
     c.delete()
 
 
+# A GATED realization of the resuming loop for races the poll cadence
+# used to lose under contention: the worker runs freely to step 2, then
+# HOLDS until the test drops a `go` file in the cluster root (`..` from
+# each worker's cwd). The test controls exactly when the survivors may
+# outrun the supervisor — the poll-cadence assumption the flake note in
+# PR 10 asked to make explicit, as a release gate instead of a timing
+# bet.
+_GATED_LOOP = ('i=$( [ -f ckpt ] && cat ckpt || echo 0 ); '
+               'echo $i >> boots.txt; '
+               'while [ $i -lt 400 ]; do '
+               'if [ $i -ge 2 ]; then '
+               'while [ ! -f ../go ]; do sleep 0.05; done; fi; '
+               'i=$((i+1)); '
+               'echo "{\\"step\\": $i, \\"loss\\": 1.0}" '
+               '>> train_log.jsonl; '
+               'if [ $((i % 5)) -eq 0 ]; then echo $i > ckpt; fi; '
+               'sleep 0.05; done')
+
+
 def test_degraded_quorum_continues_when_budget_exhausted(tmp_path):
     """A worker with no restart budget left degrades the cluster; with
     ``workers_alive >= quorum`` the run keeps going to the target
-    instead of today's all-or-nothing fail-fast."""
-    c = _cluster(tmp_path, num_workers=3,
-                 fault_plan=FaultPlan(kill_worker_at_step={2: 2}))
+    instead of today's all-or-nothing fail-fast.
+
+    Deterministic by construction (the PR 10 deflake): the old shape
+    raced the fault trigger + detection polls against a free-running
+    45-steps/s shell payload, and under box contention the survivors
+    reached the target before the supervisor ever observed the death —
+    identical failure at pristine HEAD. Now the payload HOLDS at step
+    2 until the test releases it: worker 2 is killed outright before
+    supervision starts, the first poll deterministically sees it dead
+    (detect → budget exhausted → degraded quorum), and only THEN are
+    the survivors released to run to the target."""
+    import threading
+
+    c = _cluster(tmp_path, num_workers=3, train_command=_GATED_LOOP)
     c.create()
-    sup = ClusterSupervisor(c, SupervisorConfig(
-        quorum=2, max_restarts_per_worker=0))
-    got = sup.run_until_step(15, poll_secs=0.2, timeout_secs=120.0)
-    assert got["step"] >= 15
-    by_action = got["recovery"]["by_action"]
-    assert by_action.get("restart_budget_exhausted") == 1
-    assert "restart" not in by_action
-    s = summarize_recovery(c.exec.journal_path)
-    assert s["quorum_transitions"][0]["workers_alive"] == 2
-    assert s["quorum_transitions"][0]["degraded"] is True
+    c.run_train()
+    try:
+        # wait for worker 2 to boot and reach its hold point, then
+        # kill it — no fault-plan/poll race, the death precedes tick 1
+        log2 = c.cfg.worker_dir(2) / "train_log.jsonl"
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            if log2.exists() and log2.read_text().strip():
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("worker 2 never produced a log line")
+        c.kill_all(worker="2")
+
+        sup = ClusterSupervisor(c, SupervisorConfig(
+            quorum=2, max_restarts_per_worker=0))
+        result: dict = {}
+
+        def supervise():
+            result["got"] = sup.supervise_until_step(
+                15, poll_secs=0.2, timeout_secs=120.0)
+
+        th = threading.Thread(target=supervise, daemon=True)
+        th.start()
+        # explicit ordering: the budget-exhausted event must land
+        # BEFORE the survivors may move past their hold point
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            if any(e["action"] == "restart_budget_exhausted"
+                   for e in sup.events):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("budget exhaustion never journaled")
+        (c.cfg.root / "go").touch()
+        th.join(timeout=120.0)
+        assert not th.is_alive(), "supervised run did not finish"
+        got = result["got"]
+        assert got["step"] >= 15
+        by_action = got["recovery"]["by_action"]
+        assert by_action.get("restart_budget_exhausted") == 1
+        assert "restart" not in by_action
+        s = summarize_recovery(c.exec.journal_path)
+        assert s["quorum_transitions"][0]["workers_alive"] == 2
+        assert s["quorum_transitions"][0]["degraded"] is True
+    finally:
+        c.kill_all()
     c.delete()
 
 

@@ -152,6 +152,99 @@ def test_interval_mode_falls_back_replicated(topo8):
 
 
 # ---------------------------------------------------------------------------
+# bucketed comm overlap + resident-sharded params (ISSUE 12 tentpole)
+# ---------------------------------------------------------------------------
+
+def _canon(state, cfg, topo):
+    plan = zero1_plan_for(get_model(cfg.model), cfg, topo)
+    return canonical_save_state(state, plan)
+
+
+def test_bucketed_update_bitwise_equals_monolithic(topo8, batch64):
+    """parallel.comm_buckets regroups the sharded leaves' collectives
+    into layer-ordered buckets; the per-element cross-replica sums are
+    unchanged, so losses, params AND canonical momentum must stay
+    BITWISE equal to the monolithic (comm_buckets=1) path — the
+    correctness bar PR 6 set, pinned exactly (no tolerance)."""
+    cfg_m, cfg_b = _cfg(True), _cfg(True, parallel={
+        "shard_weight_update": True, "comm_buckets": 4})
+    st_m, hist_m = _run_steps(cfg_m, topo8, batch64)
+    st_b, hist_b = _run_steps(cfg_b, topo8, batch64)
+    for mm, mb in zip(hist_m, hist_b):
+        assert float(mm["loss"]) == float(mb["loss"])  # bitwise
+    for a, b in zip(jax.tree.leaves(jax.device_get(st_m.params)),
+                    jax.tree.leaves(jax.device_get(st_b.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(_canon(st_m, cfg_m, topo8).momentum),
+                    jax.tree.leaves(_canon(st_b, cfg_b, topo8).momentum)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_resident_sharded_bitwise_and_param_memory(topo8, batch64):
+    """parallel.resident_sharded keeps the params themselves in the
+    replica-split flat layout between steps (the arXiv:2004.13336 §5
+    ending): losses and canonical params/momentum stay bitwise equal
+    to the classic layout, per-chip param bytes drop to ~1/8 for the
+    sharded leaves, and logical_params reassembles the replicated
+    view the eval step consumes."""
+    from distributedmnist_tpu.parallel.api import logical_params
+    cfg_m = _cfg(True)
+    cfg_r = _cfg(True, parallel={"shard_weight_update": True,
+                                 "comm_buckets": 2,
+                                 "resident_sharded": True})
+    st_m, hist_m = _run_steps(cfg_m, topo8, batch64)
+    st_r, hist_r = _run_steps(cfg_r, topo8, batch64)
+    for mm, mr in zip(hist_m, hist_r):
+        assert float(mm["loss"]) == float(mr["loss"])  # bitwise
+    canon_m, canon_r = _canon(st_m, cfg_m, topo8), _canon(st_r, cfg_r, topo8)
+    for a, b in zip(jax.tree.leaves(canon_m.params),
+                    jax.tree.leaves(canon_r.params)):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b)))
+    for a, b in zip(jax.tree.leaves(canon_m.momentum),
+                    jax.tree.leaves(canon_r.momentum)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def param_bytes_per_chip(st):
+        return sum(
+            int(np.prod(l.sharding.shard_shape(l.shape))) * l.dtype.itemsize
+            for l in jax.tree.leaves(st.params))
+    rep, res = param_bytes_per_chip(st_m), param_bytes_per_chip(st_r)
+    assert res <= rep * (1 / 8 + 0.02), (res, rep)
+
+    plan_r = zero1_plan_for(get_model(cfg_r.model), cfg_r, topo8)
+    for a, b in zip(jax.tree.leaves(
+                        logical_params(st_r.params, plan_r, topo8)),
+                    jax.tree.leaves(canon_m.params)):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b)))
+
+
+def test_comm_bucket_assignment_layer_ordered_and_balanced(topo8):
+    """The bucket partition is a pure function of the plan: contiguous
+    in flatten (layer) order, covers every sharded leaf exactly once,
+    clamps to the sharded-leaf count, and collapses to one bucket at
+    comm_buckets=1."""
+    from distributedmnist_tpu.parallel.partition_rules import \
+        comm_bucket_assignment
+    import dataclasses as dc
+    cfg = _cfg(True, parallel={"shard_weight_update": True,
+                               "comm_buckets": 3})
+    plan = zero1_plan_for(get_model(cfg.model), cfg, topo8)
+    buckets = comm_bucket_assignment(plan)
+    flat = [i for b in buckets for i in b]
+    assert flat == sorted(flat)  # contiguous, layer-ordered
+    lps = jax.tree.leaves(plan.leaf_plans,
+                          is_leaf=lambda x: hasattr(x, "sharded"))
+    assert set(flat) == {i for i, lp in enumerate(lps) if lp.sharded}
+    assert 1 <= len(buckets) <= min(3, len(flat))
+    one = comm_bucket_assignment(dc.replace(plan, comm_buckets=1))
+    assert len(one) == 1 and one[0] == flat
+    many = comm_bucket_assignment(dc.replace(plan, comm_buckets=999))
+    assert len(many) == len(flat)  # clamped to the sharded-leaf count
+
+
+# ---------------------------------------------------------------------------
 # checkpoint contract
 # ---------------------------------------------------------------------------
 
@@ -220,6 +313,68 @@ def test_checkpoint_roundtrip_and_cross_knob_restore(tmp_path,
                             is_leaf=lambda x: hasattr(x, "sharded"))):
         if lp.sharded:
             assert leaf.shape == (lp.pad,)
+
+
+def test_cross_knob_restore_bucketed_resident(tmp_path,
+                                              synthetic_datasets):
+    """ISSUE 12 cross-knob matrix extension: a checkpoint saved with
+    comm_buckets=4 / resident_sharded=true restores BITWISE into the
+    monolithic layout and vice versa — the canonical artifact contract
+    holds across the new knobs (params digest, opt-state digest, and
+    the packed momentum on the reverse graft)."""
+    over = {"parallel": {"shard_weight_update": True, "comm_buckets": 4,
+                         "resident_sharded": True}}
+    d1 = str(tmp_path / "bucketres")
+    t1 = Trainer(_cfg(True, **over,
+                      train={"max_steps": 4, "log_every_steps": 2,
+                             "save_interval_steps": 2,
+                             "save_results_period": 0, "train_dir": d1,
+                             "async_checkpoint": False}),
+                 datasets=synthetic_datasets)
+    assert t1._zero1_plan is not None and t1._zero1_plan.params_sharded
+    s1 = t1.run()
+    # overlap gauges surface in the timing report iff bucketing is on
+    # (the prefetch_queue_depth pattern, obsv/timing.py)
+    overlap = s1["timing"]["overlap"]
+    assert overlap["bucket_count"] >= 1
+    assert len(overlap["per_bucket_pad_elems"]) == overlap["bucket_count"]
+    assert overlap["snapshot_stall_ms"]["count"] >= 1
+    # live flat-layout state canonicalizes to the same digest a
+    # replicated/monolithic same-seed run produces
+    digest = s1["params_digest"]
+
+    # bucketed+resident artifact → monolithic layout (buckets=1,
+    # resident off): loads with no migration, digests agree
+    t2 = Trainer(_trainer_cfg(True, d1), datasets=synthetic_datasets)
+    assert int(jax.device_get(t2.state.step)) == 4
+    assert ckpt.state_params_digest(t2.state) == digest
+
+    # the reverse: a monolithic artifact restores into the
+    # bucketed+resident layout; packed params land as [pad]-flat
+    # replica shards and canonicalize back to the same digest
+    d2 = str(tmp_path / "mono")
+    t3 = Trainer(_trainer_cfg(True, d2), datasets=synthetic_datasets)
+    s3 = t3.run()
+    assert s3["params_digest"] == digest
+    assert (ckpt.checkpoint_params_digest(d1)
+            == ckpt.checkpoint_params_digest(d2))
+    assert (ckpt.checkpoint_opt_state_digest(d1)
+            == ckpt.checkpoint_opt_state_digest(d2))
+    t4 = Trainer(_cfg(True, **over,
+                      train={"max_steps": 4, "log_every_steps": 2,
+                             "save_interval_steps": 2,
+                             "save_results_period": 0, "train_dir": d2,
+                             "async_checkpoint": False}),
+                 datasets=synthetic_datasets)
+    assert int(jax.device_get(t4.state.step)) == 4
+    for leaf, lp in zip(
+            jax.tree.leaves(t4.state.params),
+            jax.tree.leaves(t4._zero1_plan.leaf_plans,
+                            is_leaf=lambda x: hasattr(x, "sharded"))):
+        if lp.sharded:
+            assert leaf.shape == (lp.pad,)
+    assert ckpt.state_params_digest(
+        canonical_save_state(t4.state, t4._zero1_plan)) == digest
 
 
 def test_cross_optimizer_restore_is_typed_error(tmp_path,
