@@ -525,6 +525,21 @@ class ServeConfig:
     * ``poll_secs`` is the checkpoint hot-follow cadence (the swap
       itself is double-buffered: the in-flight batch finishes on the
       old weights, then the reference flips atomically).
+    * ``precision_tier`` picks which published representation of the
+      weights the replica PREFERS: ``fp32`` (the full-precision
+      artifact — the historical path), or ``bf16`` / ``int8`` (the
+      quantized tiers the publish-time pass writes into the
+      digest-verified ``.quant`` sidecar next to each checkpoint,
+      ``quant.publish_tiers``). A sidecar that is absent, torn, or
+      missing the requested tier falls back to the full-precision
+      artifact for that publish — journaled, never fatal, never served
+      unverified.
+    * ``compute_dtype`` overrides the dtype activations/matmuls run in
+      on the SERVING replica only ("" = inherit the training-side
+      resolution: ``precision.compute_dtype`` then
+      ``model.compute_dtype``). Resolved through the shared
+      ``effective_model_config`` seam so serving can run cheaper
+      numerics than training without forking the model section.
     """
 
     host: str = "127.0.0.1"
@@ -534,6 +549,65 @@ class ServeConfig:
     batch_window_ms: float = 2.0   # gather window after the first request
     poll_secs: float = 0.25
     default_deadline_ms: float = 2000.0
+    precision_tier: str = "fp32"   # fp32 | bf16 | int8
+    compute_dtype: str = ""        # "" → precision/model resolution
+
+
+# The serving-tier grammar: what ``serve.precision_tier`` accepts, and
+# (minus fp32) what the quantization pass can publish.
+SERVING_PRECISION_TIERS = ("fp32", "bf16", "int8")
+QUANT_TIERS = ("bf16", "int8")
+
+
+@dataclass(frozen=True)
+class QuantConfig:
+    """Post-training quantization at checkpoint-publish time
+    (``quant/`` — ROADMAP item 5, the serving face of the
+    storage-vs-compute dtype axis ``PrecisionConfig`` opened for
+    training).
+
+    ``publish_tiers``: comma-separated tiers to write into a
+    ``ckpt-<step>.quant.msgpack`` sidecar next to every published
+    checkpoint — ``"int8"``, ``"bf16"``, or ``"int8,bf16"``; "" = off
+    (the default: no sidecars, byte-identical publish behavior). The
+    int8 tier stores per-channel symmetric int8 weights + float32
+    scales (weight leaves with ndim ≥ 2; 1-D biases/norms stay fp32);
+    the bf16 tier stores a straight bf16 cast. The full-precision
+    artifact and its digest are BYTE-UNCHANGED by publishing — the
+    sidecar is purely additive, with its own sha256 digest sidecar
+    under the same atomic-write/torn-read contract.
+
+    ``calibration_examples``: how many held-out (test-split) examples
+    the pass runs through the fp32 and quantized graphs at publish
+    time — it records the observed activation range and the top-1
+    agreement in the sidecar metadata, and REFUSES to publish a tier
+    whose calibration agreement drops more than ``parity_epsilon``
+    below the full-precision predictions (speed must never silently
+    buy wrongness; the refusal is logged and the serving tier falls
+    back to fp32 for that publish). 0 disables calibration (tiers
+    publish unchecked — for tests and trusted recipes only).
+    """
+
+    publish_tiers: str = ""        # "" | "int8" | "bf16" | "int8,bf16"
+    calibration_examples: int = 128
+    parity_epsilon: float = 0.02
+
+    def resolved_publish_tiers(self) -> tuple[str, ...]:
+        """The validated tier tuple (the ``optim`` pattern: a bad knob
+        is a typed ConfigError naming the valid set at build time, not
+        a KeyError mid-publish)."""
+        if not self.publish_tiers:
+            return ()
+        tiers = tuple(t.strip() for t in self.publish_tiers.split(",")
+                      if t.strip())
+        for t in tiers:
+            if t not in QUANT_TIERS:
+                raise ConfigError(
+                    f"quant.publish_tiers names unknown tier {t!r}; "
+                    f"valid tiers: {', '.join(QUANT_TIERS)} "
+                    "(fp32 is the artifact itself, never a sidecar "
+                    "tier)")
+        return tiers
 
 
 @dataclass(frozen=True)
@@ -551,15 +625,45 @@ class EvalConfig:
     max_evals: int = 0  # 0 = unbounded
 
 
-def effective_model_config(cfg: "ExperimentConfig") -> ModelConfig:
-    """The model section with ``precision.compute_dtype`` applied when
-    set — the ONE resolution every model-building consumer (Trainer,
-    evaluator, serving replica) goes through, so the precision section
-    can't drift from the model section between tiers."""
-    if not cfg.precision.compute_dtype:
+# Dtypes an activations/matmul override may name. The model section's
+# own compute_dtype predates this list and stays unvalidated here (its
+# consumers jnp.dtype() it at build); the OVERRIDE knobs
+# (precision.compute_dtype, serve.compute_dtype) are validated at the
+# shared resolution point so a typo is a typed ConfigError naming the
+# valid set — the ``optim`` validation pattern — not a downstream
+# jnp.dtype TypeError in whichever consumer resolves first.
+_VALID_COMPUTE_DTYPES = ("float32", "bfloat16", "float16", "float64")
+
+
+def _checked_compute_dtype(value: str, where: str) -> str:
+    if value not in _VALID_COMPUTE_DTYPES:
+        raise ConfigError(
+            f"{where}={value!r} is not a known compute dtype; valid "
+            f"dtypes: {', '.join(_VALID_COMPUTE_DTYPES)}")
+    return value
+
+
+def effective_model_config(cfg: "ExperimentConfig",
+                           serving: bool = False) -> ModelConfig:
+    """The model section with the compute-dtype overrides applied —
+    the ONE resolution every model-building consumer (Trainer,
+    evaluator, serving replica) goes through, so the precision/serve
+    sections can't drift from the model section between tiers.
+
+    Resolution order: ``serve.compute_dtype`` (serving consumers only,
+    ``serving=True``) → ``precision.compute_dtype`` → the model
+    section's own knob. Unknown dtype strings on either override raise
+    a typed :class:`ConfigError` naming the valid set."""
+    dtype = ""
+    if serving and cfg.serve.compute_dtype:
+        dtype = _checked_compute_dtype(cfg.serve.compute_dtype,
+                                       "serve.compute_dtype")
+    elif cfg.precision.compute_dtype:
+        dtype = _checked_compute_dtype(cfg.precision.compute_dtype,
+                                       "precision.compute_dtype")
+    if not dtype:
         return cfg.model
-    return dataclasses.replace(cfg.model,
-                               compute_dtype=cfg.precision.compute_dtype)
+    return dataclasses.replace(cfg.model, compute_dtype=dtype)
 
 
 @dataclass(frozen=True)
@@ -576,6 +680,7 @@ class ExperimentConfig:
     train: TrainConfig = field(default_factory=TrainConfig)
     eval: EvalConfig = field(default_factory=EvalConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    quant: QuantConfig = field(default_factory=QuantConfig)
 
     # ---- construction helpers -------------------------------------------------
 
@@ -652,6 +757,7 @@ _SECTION_TYPES = {
     ("ExperimentConfig", "train"): TrainConfig,
     ("ExperimentConfig", "eval"): EvalConfig,
     ("ExperimentConfig", "serve"): ServeConfig,
+    ("ExperimentConfig", "quant"): QuantConfig,
 }
 
 

@@ -123,7 +123,8 @@ def _serve(args) -> None:
     cfg = wait_for_run_config(args.train_dir)
     overrides = {k: getattr(args, k) for k in
                  ("host", "port", "max_batch", "queue_depth",
-                  "batch_window_ms", "poll_secs", "default_deadline_ms")
+                  "batch_window_ms", "poll_secs", "default_deadline_ms",
+                  "precision_tier", "compute_dtype")
                  if getattr(args, k) is not None}
     scfg = dataclasses.replace(cfg.serve, **overrides)
     ServingReplica(args.train_dir, serve_dir=args.serve_dir,
@@ -471,6 +472,15 @@ def main(argv=None) -> None:
                     dest="poll_secs", help="checkpoint-follow cadence")
     pv.add_argument("--default-deadline-ms", type=float, default=None,
                     dest="default_deadline_ms")
+    pv.add_argument("--precision-tier", default=None,
+                    dest="precision_tier",
+                    help="fp32 | bf16 | int8 — prefer the named "
+                         "quantized sidecar tier (quant.publish_tiers) "
+                         "over the full-precision artifact; absent/"
+                         "torn sidecars fall back to fp32, journaled")
+    pv.add_argument("--compute-dtype", default=None, dest="compute_dtype",
+                    help="serving-side activations/matmul dtype "
+                         "override (serve.compute_dtype)")
     pv.set_defaults(fn=_serve)
 
     pl = sub.add_parser(

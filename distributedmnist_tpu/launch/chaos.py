@@ -410,6 +410,15 @@ class ChaosConfig:
     # kill/hang/stall triggers on serving replicas are in HEARTBEAT
     # units (terminal outcomes that replica produced)
     serve_fault_window: tuple[int, int] = (5, 40)
+    # Per-replica serving precision tiers (quantized serving under
+    # fire): entry i names replica i+1's serve.precision_tier; missing
+    # entries default to fp32. Any non-fp32 entry makes the PUBLISHER
+    # payload write the matching quant sidecars
+    # (quant.publish_tiers), so the corrupt-published-artifact fault —
+    # which tears the .quant sidecar alongside the checkpoint — also
+    # exercises the sidecar's digest refusal on a live replica.
+    # None/() = every replica full precision (historical behavior).
+    serve_precision_tiers: tuple[str, ...] | None = None
     # schedule intensity
     max_faults: int = 3
     min_faults: int = 1
@@ -454,6 +463,18 @@ class ChaosConfig:
     shrink: bool = True
     shrink_max_probes: int = 8
 
+    def __post_init__(self) -> None:
+        # the repo's knob contract: a typo is a typed error naming the
+        # valid set at config build — not a replica crash-looping
+        # against its restart budget mid-trial
+        from ..core.config import SERVING_PRECISION_TIERS
+        for t in (self.serve_precision_tiers or ()):
+            if t not in SERVING_PRECISION_TIERS:
+                raise ClusterError(
+                    f"serve_precision_tiers names unknown tier {t!r}; "
+                    f"valid tiers: "
+                    f"{', '.join(SERVING_PRECISION_TIERS)}")
+
     @classmethod
     def from_file(cls, path: str | Path) -> "ChaosConfig":
         d = json.loads(Path(path).read_text())
@@ -464,6 +485,10 @@ class ChaosConfig:
             d["stall_ms_range"] = tuple(d["stall_ms_range"])
         if "serve_fault_window" in d:
             d["serve_fault_window"] = tuple(d["serve_fault_window"])
+        if "serve_precision_tiers" in d and \
+                d["serve_precision_tiers"] is not None:
+            d["serve_precision_tiers"] = tuple(
+                str(t) for t in d["serve_precision_tiers"])
         if "resize_worlds" in d and d["resize_worlds"] is not None:
             d["resize_worlds"] = tuple(int(w) for w in d["resize_worlds"])
         return cls(**d)
@@ -533,19 +558,46 @@ class ChaosConfig:
             if measured_boot_s is not None and measured_boot_s > 0:
                 floor = 2500.0 * measured_boot_s / max(1, self.until_step)
                 pace = min(2000.0, max(pace, floor))
-            return _SERVE_PUBLISHER_PAYLOAD.format(
+            cmd = _SERVE_PUBLISHER_PAYLOAD.format(
                 max_steps=self.until_step, pace=round(pace, 1),
                 save=self.save_interval_steps)
+            quant = self.resolved_quant_publish_tiers()
+            if quant:
+                # the publisher writes the sidecars the quantized
+                # replicas prefer (also runs in the fault-free
+                # reference — same payload, bitwise determinism holds:
+                # sidecars never touch the train state)
+                cmd += f" quant.publish_tiers={','.join(quant)}"
+            return cmd
         return _TRAIN_PAYLOAD.format(max_steps=self.until_step,
                                      save=self.save_interval_steps)
 
+    def resolved_quant_publish_tiers(self) -> tuple[str, ...]:
+        """The distinct non-fp32 tiers any replica serves — what the
+        publisher must write sidecars for (order-stable)."""
+        tiers: list[str] = []
+        for t in (self.serve_precision_tiers or ()):
+            if t and t != "fp32" and t not in tiers:
+                tiers.append(t)
+        return tuple(tiers)
+
     def resolved_worker_commands(self) -> dict[str, str]:
         """Per-worker payload overrides — serving mode's mixed roster
-        (publisher + replicas); empty for the uniform payloads."""
+        (publisher + replicas); empty for the uniform payloads.
+        ``serve_precision_tiers`` entry i pins replica i+1's tier (a
+        mixed fp32/int8 roster exercises both weight paths under one
+        fault plan)."""
         if self.payload != "serving":
             return {}
-        serve = _SERVE_PAYLOAD.format(queue=self.serve_queue_depth)
-        return {str(k): serve for k in range(1, self.trial_num_workers())}
+        tiers = self.serve_precision_tiers or ()
+        out: dict[str, str] = {}
+        for k in range(1, self.trial_num_workers()):
+            cmd = _SERVE_PAYLOAD.format(queue=self.serve_queue_depth)
+            tier = tiers[k - 1] if k - 1 < len(tiers) else ""
+            if tier and tier != "fp32":
+                cmd += f" --precision-tier {tier}"
+            out[str(k)] = cmd
+        return out
 
     def trial_num_workers(self) -> int:
         return (1 + self.serve_replicas if self.payload == "serving"
@@ -677,6 +729,17 @@ class ChaosCampaign:
             outcome["mode"] = "serving"
             outcome["serve_workers"] = list(range(1, num_workers))
             outcome["serving"] = load_result.get("summary")
+            # weight-swap-by-tier accounting over every replica's
+            # serve journal (tier-less legacy swaps count as fp32) —
+            # the evidence a quantized campaign arm actually served
+            # its tier, and that sidecar digest refusals fired
+            from ..obsv.journal import summarize_serving_swaps
+            from ..obsv.report import load_jsonl
+            serve_recs: list[dict] = []
+            for k in range(1, num_workers):
+                serve_recs += load_jsonl(
+                    lcfg.worker_dir(k) / "serve_log.jsonl", "serve")
+            outcome["serve_swaps"] = summarize_serving_swaps(serve_recs)
         outcome["duration_s"] = round(time.monotonic() - t0, 3)
         (lcfg.root / "outcome.json").write_text(
             json.dumps(outcome, indent=2, default=str))
@@ -920,6 +983,7 @@ class ChaosCampaign:
                    # summary (requests, dropped, p50/p99, rejects,
                    # model steps served) rides into the campaign report
                    "serving": outcome.get("serving"),
+                   "serve_swaps": outcome.get("serve_swaps"),
                    "verdicts": check["verdicts"],
                    "violations": check["violations"]}
             if check["violations"] and cfg.shrink and reproducer is None:

@@ -1186,15 +1186,30 @@ class LocalProcessCluster(ClusterBackend):
                              "worker": k, "target": None,
                              "at_step": prog[k], "planned_step": s})
                         continue
-                    keep = max(1, target.stat().st_size // 2)
-                    self.exec.run(["truncate", "-s", str(keep),
-                                   str(target)], verb="fault", check=False)
-                    self.exec.journal(
-                        {"event": "fault",
-                         "action": "corrupt_latest_checkpoint",
-                         "worker": k, "target": target.name,
-                         "truncated_to": keep,
-                         "at_step": prog[k], "planned_step": s})
+                    targets = [target]
+                    if target.name.endswith(".msgpack") and \
+                            not target.name.endswith(".quant.msgpack"):
+                        # the publish-time quantization pass writes a
+                        # .quant sidecar next to the artifact — tear it
+                        # TOO, so a serving replica on a quantized
+                        # precision tier exercises the SIDECAR's digest
+                        # refusal, not just the checkpoint's
+                        quant = target.with_name(
+                            target.name[:-len(".msgpack")]
+                            + ".quant.msgpack")
+                        if quant.exists():
+                            targets.append(quant)
+                    for tgt in targets:
+                        keep = max(1, tgt.stat().st_size // 2)
+                        self.exec.run(["truncate", "-s", str(keep),
+                                       str(tgt)], verb="fault",
+                                      check=False)
+                        self.exec.journal(
+                            {"event": "fault",
+                             "action": "corrupt_latest_checkpoint",
+                             "worker": k, "target": tgt.name,
+                             "truncated_to": keep,
+                             "at_step": prog[k], "planned_step": s})
 
     def poll(self) -> dict[str, Any] | None:
         """Tail worker 0's ``train_log.jsonl`` via a real subprocess;

@@ -452,7 +452,13 @@ def check_serving(trial_dir: str | Path, outcome: dict,
       the injector journaled tearing that step's artifact: digest
       verification (plus fallback-to-previous-loadable) must have
       skipped it. Swaps predating the tear served the then-intact
-      bytes and are correct.
+      bytes and are correct. Covers the quantized ``.quant`` sidecar
+      tiers too: a swap that records which artifact it read
+      (``source_artifact``) is matched against the torn target by
+      NAME — a replica that served the intact fp32 artifact after
+      only the sidecar was torn (or vice versa) is digest
+      verification working, not a violation; legacy swaps without the
+      field keep the historical step-based match.
     * **serve_monotone** — each replica's journaled ``weight_swap``
       step series is monotone non-decreasing (across restarts too: the
       publisher's steps only advance).
@@ -537,9 +543,17 @@ def check_serving(trial_dir: str | Path, outcome: dict,
         for sw in swaps:
             step = sw.get("step")
             at = sw.get("time", sw.get("ts"))
+            src = sw.get("source_artifact")
             for f in corrupt_faults:
-                torn_step = _ckpt_name_step(str(f["target"]))
+                torn_name = str(f["target"])
+                torn_step = _ckpt_name_step(torn_name)
                 f_at = f.get("ts", f.get("time"))
+                if src is not None and src != torn_name:
+                    # the swap names the artifact it read and it is
+                    # NOT the torn one (e.g. the intact quant sidecar
+                    # while the fp32 artifact was torn) — different
+                    # bytes, different digest, no claim violated
+                    continue
                 if not (torn_step is not None and step == torn_step
                         and isinstance(at, (int, float))
                         and isinstance(f_at, (int, float))):

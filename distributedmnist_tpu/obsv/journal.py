@@ -86,6 +86,28 @@ def _percentile(sorted_vals: list[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+def summarize_serving_swaps(records: list[dict]) -> dict[str, Any]:
+    """Weight-swap accounting over serve-journal records (``event:
+    "serve"``), broken down by the precision tier each swap installed.
+    A ``weight_swap`` WITHOUT a ``tier`` field is a legacy journal
+    from before the quantized serving tiers existed — it counts as
+    ``fp32`` (the only representation that path ever served), so
+    replaying pre-quantization artifacts can never KeyError here.
+    ``quant_sidecar_fallbacks`` counts publishes where a quantized
+    replica fell back to full precision (absent/torn/tier-less
+    sidecar) — the nightly campaign's evidence that the sidecar digest
+    refusal actually fired."""
+    swaps = [r for r in records if r.get("action") == "weight_swap"]
+    by_tier: dict[str, int] = {}
+    for r in swaps:
+        tier = r.get("tier") or "fp32"
+        by_tier[tier] = by_tier.get(tier, 0) + 1
+    return {"swaps": len(swaps), "by_tier": by_tier,
+            "quant_sidecar_fallbacks": sum(
+                1 for r in records
+                if r.get("action") == "follow_quant_sidecar_fallback")}
+
+
 def summarize_mttr(records: list[dict]) -> dict[str, Any]:
     """MTTR (mean-time-to-recovery) over the recovery episodes in a
     journal: each ``resume`` closes a detect→respawned→first-moved-step
@@ -205,6 +227,8 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
     fault_trials: list[dict[str, Any]] = []
     serving_trials: list[dict[str, Any]] = []
     reconfigures = 0
+    swaps_by_tier: dict[str, int] = {}
+    quant_fallbacks = 0
     for rec in records:
         sv = rec.get("serving")
         if sv is not None:
@@ -218,7 +242,22 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
                 "reject_rate": sv.get("reject_rate"),
                 "p50_ms": (sv.get("latency_ms") or {}).get("p50"),
                 "p99_ms": (sv.get("latency_ms") or {}).get("p99"),
-                "model_steps_served": sv.get("model_steps_served")})
+                "model_steps_served": sv.get("model_steps_served"),
+                "tiers_served": sv.get("tiers_served"),
+                "serve_swaps": rec.get("serve_swaps")})
+            # swap-by-tier tally across the campaign; a trial record
+            # (or its swaps) written before the quantized tiers
+            # existed carries no tier breakdown — those swaps count as
+            # fp32, the only tier that path ever served (never a
+            # KeyError on legacy journals)
+            sw = rec.get("serve_swaps") or {}
+            tiers = sw.get("by_tier")
+            if tiers is None:
+                tiers = {"fp32": sw.get("swaps", 0)} if sw else {}
+            for tier, n in tiers.items():
+                key = tier or "fp32"
+                swaps_by_tier[key] = swaps_by_tier.get(key, 0) + (n or 0)
+            quant_fallbacks += sw.get("quant_sidecar_fallbacks") or 0
         f = rec.get("faults")
         if f is not None:
             fault_trials.append({"trial": rec.get("trial"),
@@ -298,6 +337,13 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
                 "responses": sum(t["responses"] or 0
                                  for t in serving_trials),
                 "errors": sum(t["errors"] or 0 for t in serving_trials),
+                # which precision tier each installed swap served
+                # (tier-less legacy swaps counted as fp32) and how
+                # often a quantized replica's sidecar preference fell
+                # back to full precision — the campaign-level evidence
+                # for the quantized serving path
+                "swaps_by_tier": swaps_by_tier,
+                "quant_sidecar_fallbacks": quant_fallbacks,
                 "per_trial": serving_trials}
                 if serving_trials else None)}
 

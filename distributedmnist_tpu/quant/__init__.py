@@ -1,0 +1,15 @@
+"""Post-training quantization (``quant/``): the publish-time pass that
+writes int8/bf16 serving tiers as digest-verified sidecar artifacts,
+and the dequantize/parity helpers the serving replica and the
+accuracy oracle share."""
+
+from .ptq import (QuantPublisher, calibrate_tiers, cast_tree_bf16,
+                  dequantize_tree_int8, dynamic_input_fake_quant,
+                  parity_report, quantize_leaf_int8, quantize_tree_int8,
+                  tree_params_digest)
+
+__all__ = [
+    "QuantPublisher", "calibrate_tiers", "cast_tree_bf16",
+    "dequantize_tree_int8", "dynamic_input_fake_quant", "parity_report",
+    "quantize_leaf_int8", "quantize_tree_int8", "tree_params_digest",
+]

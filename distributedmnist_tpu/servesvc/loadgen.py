@@ -95,6 +95,9 @@ def run_load(client: ServeClient, num_requests: int | None,
                    "time": time.time(), "status": got.get("status"),
                    "reason": got.get("reason"),
                    "model_step": got.get("model_step"),
+                   # which precision tier actually answered — the
+                   # loadgen artifact's record of what a sweep measured
+                   "tier": got.get("tier"),
                    "attempts": got.get("attempts"),
                    "endpoint": got.get("endpoint"),
                    "latency_ms": got.get("latency_ms")}
@@ -130,6 +133,9 @@ def summarize_outcomes(outcomes: list[dict], issued: int,
         by_reason[key] = by_reason.get(key, 0) + 1
     steps = sorted({r["model_step"] for r in ok
                     if isinstance(r.get("model_step"), int)})
+    # which precision tier(s) answered; a pre-quantization journal has
+    # no tier field — those responses count as fp32 (the legacy path)
+    tiers = sorted({r.get("tier") or "fp32" for r in ok})
     out: dict[str, Any] = {
         "issued": issued,
         "terminal": len(outcomes),
@@ -144,6 +150,7 @@ def summarize_outcomes(outcomes: list[dict], issued: int,
         "duration_s": round(duration_s, 3),
         "throughput_rps": round(len(outcomes) / max(duration_s, 1e-9), 2),
         "model_steps_served": steps,
+        "tiers_served": tiers,
     }
     if lat:
         out["latency_ms"] = {"p50": _percentile(lat, 0.50),

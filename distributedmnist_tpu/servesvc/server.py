@@ -16,6 +16,20 @@ thread + one checkpoint-follower thread. The robustness contract
 * **Served model step is monotone non-decreasing across swaps** — a
   swap only installs a strictly newer step.
 
+Precision tiers (``serve.precision_tier``): with ``bf16`` or ``int8``
+the replica PREFERS the publish-time quantized sidecar
+(``ckpt-<step>.quant.msgpack``, written by the ``quant/`` pass behind
+``quant.publish_tiers``) — digest-verified through the same machinery
+as the checkpoint itself, int8 weights resident on device and
+dequantized inside the jitted predict (scale fusion). A sidecar that
+is absent, torn, or missing the requested tier journals a
+``follow_quant_sidecar_fallback`` and that publish serves from the
+full-precision artifact instead — the torn-digest invariant covers
+sidecars exactly like checkpoints, and the follower cursor still
+advances (no skip-loop wedge). Every ``weight_swap`` records the
+``tier`` it installed plus ``source_artifact``/``source_digest``, so
+the journals say which representation actually served.
+
 Wire protocol: one JSON line per connection each way (the client shim
 opens a connection per request — serving rates here are bounded by
 model compute, not connection setup).
@@ -53,7 +67,8 @@ from typing import Any
 import jax
 import numpy as np
 
-from ..core.config import (ExperimentConfig, MeshConfig, ServeConfig,
+from ..core.config import (SERVING_PRECISION_TIERS, ConfigError,
+                           ExperimentConfig, MeshConfig, ServeConfig,
                            effective_model_config)
 from ..core.log import JsonlSink, get_logger
 from ..core.mesh import Topology, make_topology
@@ -113,7 +128,17 @@ class ServingReplica:
                     "layouts; serve from a non-pipeline checkpoint")
             self.topo = make_topology(MeshConfig(num_replicas=1),
                                       devices=jax.devices()[:1])
-        self.model = get_model(effective_model_config(cfg))
+        # serve-side compute-dtype resolution (serve.compute_dtype →
+        # precision.compute_dtype → model.compute_dtype), validated at
+        # the shared seam — a typo is a typed ConfigError here, not a
+        # jnp error mid-request
+        self.model = get_model(effective_model_config(cfg, serving=True))
+        self.tier = self.scfg.precision_tier or "fp32"
+        if self.tier not in SERVING_PRECISION_TIERS:
+            raise ConfigError(
+                f"serve.precision_tier={self.tier!r} is not a known "
+                f"tier; valid tiers: "
+                f"{', '.join(SERVING_PRECISION_TIERS)}")
         self.template = init_train_state(self.model, cfg, self.topo)
         self._param_specs = state_partition_specs(
             self.model, cfg, self.topo).params
@@ -125,14 +150,30 @@ class ServingReplica:
             logits = model.apply(params, x, train=False)
             return model.predictions(logits)
 
-        # one jit; each bucket shape compiles once on first use
+        # one jit; each bucket shape compiles once on first use. The
+        # fp32 predict always exists (it is the fallback every tier
+        # degrades to); quant-tier predicts are built lazily on the
+        # first sidecar install (quant/ptq.build_tier_predict — int8
+        # dequantizes in-graph, bf16 applies the bf16-stored leaves
+        # through a bf16-compute model unless serve.compute_dtype
+        # pinned something else)
         self._predict = jax.jit(predict)
+        self._predict_fp32 = self._predict
+        self._tier_predict_fns: dict[str, Any] = {"fp32": self._predict}
 
         # current weights (batcher-owned) + double buffer staged by the
         # follower thread, flipped at a batch boundary
         self._params = None
         self.model_step = -1
         self.model_digest: str | None = None
+        self.model_tier: str | None = None      # tier actually installed
+        self.model_source_digest: str | None = None
+        # last step a sidecar fallback was journaled for: when the
+        # fp32 path ALSO has nothing to restore, the follower cursor
+        # stays put and every poll re-reads the same step — the
+        # fallback must journal once per publish, not once per tick
+        # (quant_sidecar_fallbacks counts refusals, not poll cadence)
+        self._quant_fallback_step: int | None = None
         self._staged: tuple | None = None
         self._staged_lock = threading.Lock()
 
@@ -181,13 +222,84 @@ class ServingReplica:
 
     # -- weights ------------------------------------------------------
 
+    def _tier_predict(self, tier: str):
+        """The jitted predict for a quant tier, built once per tier
+        per replica (each bucket shape still compiles on first use)."""
+        fn = self._tier_predict_fns.get(tier)
+        if fn is None:
+            import dataclasses
+
+            from ..quant.ptq import build_tier_predict
+            model = self.model
+            if tier == "bf16" and not self.cfg.serve.compute_dtype:
+                # the tier's point is MXU-native bf16 end-to-end; an
+                # explicit serve.compute_dtype still wins
+                model = get_model(dataclasses.replace(
+                    effective_model_config(self.cfg, serving=True),
+                    compute_dtype="bfloat16"))
+            fn = jax.jit(build_tier_predict(model, self.template.params,
+                                            tier))
+            self._tier_predict_fns[tier] = fn
+        return fn
+
+    def _read_quant_tier(self, step: int, t0: float):
+        """The sidecar-preference half of the follower read: a
+        digest-verified quant sidecar holding the configured tier →
+        a staged install; anything else (absent, torn, tier missing)
+        journals ``follow_quant_sidecar_fallback`` and returns None so
+        the read falls through to the full-precision artifact — the
+        cursor still advances through THAT path, so a bad sidecar can
+        never wedge the follower's skip loop."""
+        def fallback(reason: str):
+            if self._quant_fallback_step != step:
+                self._quant_fallback_step = step
+                self._journal({"action": "follow_quant_sidecar_fallback",
+                               "step": step, "tier": self.tier,
+                               "reason": reason})
+            return None
+        try:
+            payload = ckpt.read_quant_sidecar(self.train_dir, step)
+            tiers = payload["tiers"]
+            if self.tier not in tiers:
+                raise KeyError(
+                    f"sidecar has tiers {sorted(tiers)}, not "
+                    f"{self.tier!r}")
+        except FileNotFoundError:
+            return fallback("sidecar_absent")
+        except (OSError, ValueError, KeyError) as e:
+            # ValueError covers CheckpointCorruptError: the digest
+            # refusal — a torn sidecar is never served, same contract
+            # as a torn checkpoint
+            return fallback(f"{type(e).__name__}: {e}")
+        if step <= self.model_step:
+            return ("noswap", step)
+        params = jax.device_put(tiers[self.tier])
+        meta = payload.get("meta") or {}
+        return ("swap", {
+            "params": params,
+            "predict": self._tier_predict(self.tier),
+            "step": step,
+            "digest": ckpt.quant_sidecar_digest(self.train_dir, step),
+            "tier": self.tier,
+            "source_artifact": ckpt.quant_sidecar_path(
+                self.train_dir, step).name,
+            "source_digest": meta.get("source_params_digest"),
+        }, t0)
+
     def _read_weights(self, ptr_step: int):
-        """The follower's ``read``: digest-verified restore with
-        fallback-to-previous-loadable-step — a torn/corrupt newest
-        publish is skipped (journaled), never served. Returns a staged
-        swap, or a no-swap marker when the fallback landed on (or
-        behind) what we already serve."""
+        """The follower's ``read``: tier preference first (the quant
+        sidecar when ``serve.precision_tier`` names one), then the
+        digest-verified full-precision restore with
+        fallback-to-previous-loadable-step — a torn/corrupt publish is
+        skipped (journaled), never served. Returns a staged swap, or a
+        no-swap marker when the fallback landed on (or behind) what we
+        already serve."""
         t0 = time.time()
+        if self.tier != "fp32":
+            got = self._read_quant_tier(ptr_step, t0)
+            if got is not None:
+                return got
+            # journaled fallback: this publish serves full precision
         restored = ckpt.restore_checkpoint(
             self.train_dir, self.template, None,
             on_event=lambda rec: self._journal(
@@ -204,22 +316,57 @@ class ServingReplica:
             return ("noswap", at_step)
         params = self.topo.device_put_state(state.params, self._param_specs)
         digest = ckpt.artifact_digest(self.train_dir, at_step)
-        return ("swap", params, at_step, digest, t0)
+        # name the artifact the restore actually read — single-file
+        # layout only; a sharded (manifest) restore records None so
+        # the serve_digest invariant keeps its historical step-based
+        # match instead of name-matching a file that doesn't exist
+        name = f"ckpt-{at_step:08d}.msgpack"
+        if not (self.train_dir / name).exists():
+            name = None
+        return ("swap", {
+            # predict None = "the replica's fp32 predict" — resolved at
+            # install time so a test-wrapped self._predict stays live
+            "params": params, "predict": None,
+            "step": at_step, "digest": digest, "tier": "fp32",
+            "source_artifact": name,
+            "source_digest": digest,
+        }, t0)
+
+    def _install(self, staged: dict, t0: float,
+                 initial: bool = False) -> None:
+        """Flip the staged weights in (batcher/boot thread only) and
+        journal the swap with its tier + source identity."""
+        prev = self.model_step
+        self._params = staged["params"]
+        if staged["predict"] is not None:
+            self._predict = staged["predict"]
+        elif self.model_tier not in (None, "fp32"):
+            # downgrading a quant tier to fp32: restore the pristine
+            # fp32 predict (a pure-fp32 replica never reassigns
+            # self._predict, so tests wrapping it keep their wrapper)
+            self._predict = self._predict_fp32
+        self.model_step = staged["step"]
+        self.model_digest = staged["digest"]
+        self.model_tier = staged["tier"]
+        self.model_source_digest = staged["source_digest"]
+        self.swaps += 1
+        rec = {"action": "weight_swap", "step": staged["step"],
+               "from_step": prev, "digest": staged["digest"],
+               "tier": staged["tier"],
+               "source_artifact": staged["source_artifact"],
+               "source_digest": staged["source_digest"],
+               "swap_ms": round((time.time() - t0) * 1e3, 3)}
+        if initial:
+            rec["initial"] = True
+        self._journal(rec)
 
     def _load_initial(self, timeout_s: float = 600.0) -> None:
         deadline = time.time() + timeout_s
         while time.time() < deadline and not self._stop.is_set():
             got = self.follower.poll(self._read_weights)
             if got is not None and got[0] == "swap":
-                _, params, step, digest, t0 = got
-                self._params = params
-                self.model_step = step
-                self.model_digest = digest
-                self._journal({"action": "weight_swap", "step": step,
-                               "from_step": -1, "digest": digest,
-                               "swap_ms": round((time.time() - t0) * 1e3, 3),
-                               "initial": True})
-                self.swaps += 1
+                _, staged, t0 = got
+                self._install(staged, t0, initial=True)
                 return
             time.sleep(min(1.0, self.scfg.poll_secs))
         raise TimeoutError(
@@ -242,22 +389,15 @@ class ServingReplica:
     def _maybe_swap(self) -> None:
         """Batch-boundary flip: the in-flight batch already drained on
         the old weights; installing the staged buffer is one reference
-        assignment. Journals step + digest + swap latency."""
+        assignment. Journals step + digest + tier + swap latency."""
         with self._staged_lock:
             staged, self._staged = self._staged, None
         if staged is None:
             return
-        params, step, digest, t0 = staged
-        if step <= self.model_step:
+        install, t0 = staged
+        if install["step"] <= self.model_step:
             return  # monotone: never swap backwards
-        prev = self.model_step
-        self._params = params
-        self.model_step = step
-        self.model_digest = digest
-        self.swaps += 1
-        self._journal({"action": "weight_swap", "step": step,
-                       "from_step": prev, "digest": digest,
-                       "swap_ms": round((time.time() - t0) * 1e3, 3)})
+        self._install(install, t0)
 
     # -- socket front door --------------------------------------------
 
@@ -285,6 +425,15 @@ class ServingReplica:
                 "input_shape": list(self.model.input_shape),
                 "input_dtype": str(np.dtype(self.model.input_dtype)),
                 "model_step": self.model_step,
+                # which representation this replica PREFERS vs what it
+                # actually has installed right now (a sidecar fallback
+                # makes these differ), plus the installed tier's source
+                # identity — what lets a loadgen artifact record which
+                # tier a sweep ACTUALLY measured
+                "precision_tier": self.tier,
+                "active_tier": self.model_tier,
+                "model_digest": self.model_digest,
+                "tier_source_digest": self.model_source_digest,
                 "max_batch": self.scfg.max_batch}
 
     def _handle_conn(self, conn) -> None:
@@ -411,17 +560,18 @@ class ServingReplica:
         x = np.zeros((bucket, *self.model.input_shape), dtype)
         for i, it in enumerate(live):
             x[i] = it.inputs
-        step, digest = self.model_step, self.model_digest
+        step, digest, tier = (self.model_step, self.model_digest,
+                              self.model_tier)
         probs = np.asarray(jax.device_get(self._predict(self._params, x)))
         for i, it in enumerate(live):
             p = probs[i]
             self._terminal(
-                "respond", it.req_id, model_step=step,
+                "respond", it.req_id, model_step=step, tier=tier,
                 batch=len(live), bucket=bucket,
                 latency_ms=round((time.time() - it.admitted_at) * 1e3, 3))
             self._respond(it.conn, {
                 "id": it.req_id, "status": "ok", "model_step": step,
-                "model_digest": digest,
+                "model_digest": digest, "tier": tier,
                 "prediction": int(np.argmax(p)),
                 "probs": [round(float(v), 6) for v in p]})
 
@@ -471,6 +621,8 @@ class ServingReplica:
         tmp.replace(endpoint_path)
         self._journal({"action": "serve_start", "port": self.bound_port,
                        "model_step": self.model_step,
+                       "precision_tier": self.tier,
+                       "active_tier": self.model_tier,
                        "queue_depth": self.scfg.queue_depth,
                        "max_batch": self.scfg.max_batch})
         self._maybe_heartbeat()

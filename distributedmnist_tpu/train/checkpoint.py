@@ -12,6 +12,21 @@ and the evaluator's read side (src/nn_eval.py:70-88). Differences:
   time-seeded data stream from scratch).
 * A ``checkpoint.json`` pointer names the latest step — the moral
   equivalent of TF's ``checkpoint`` proto file.
+* **Quantized sidecar tiers** (``quant/`` — the serving-precision
+  pass): a publish may additionally write
+  ``ckpt-{step}.quant.msgpack`` next to the artifact, holding
+  ``{"tiers": {tier: state-dict-shaped param tree}, "meta": json}``
+  for the configured tiers — ``int8`` leaves are
+  ``{"q": int8[..., C], "scale": float32[1, ..., C]}`` per-channel
+  pairs (1-D leaves stay float32), ``bf16`` leaves a straight bf16
+  cast; ``meta`` records the source params' sha256, the calibration
+  stats, and the tier list. The sidecar gets its OWN ``.sha256``
+  digest sidecar through the same atomic-write machinery, so a torn
+  sidecar is refused exactly like a torn checkpoint (the serving
+  replica then falls back to the full-precision artifact). Sidecars
+  are ADDITIVE: the full-precision artifact's bytes and digest are
+  untouched by publishing them, they never make a step "loadable" on
+  their own, and they garbage-collect with their step.
 * **Per-host sharded format** (SURVEY §2.3 "per-host array
   serialization", ≙ the Saver-over-NFS multi-worker layout): when the
   state holds arrays whose shards this process cannot fully
@@ -439,11 +454,27 @@ class AsyncCheckpointer:
                 self._pending = None
                 self._busy = True
             try:
-                *args, prepare = job
+                *args, prepare, publish = job
                 if prepare is not None:
                     # device snapshot → host + canonical layout, off
                     # the train loop's critical path
                     args[1] = prepare(args[1])
+                if publish is not None:
+                    # sidecar hook (the quant tiers): runs BEFORE the
+                    # artifact/pointer write — a follower that sees
+                    # the pointer name a new step must find its
+                    # sidecar already on disk, or a fast poll lands in
+                    # the gap, falls back to fp32, and (cursor
+                    # advanced) never revisits that step's tier. A
+                    # sidecar with no artifact yet is harmless: it
+                    # never makes a step loadable and GCs with it. A
+                    # sidecar failure must never read as a failed
+                    # CHECKPOINT.
+                    try:
+                        publish(args[1], args[2])
+                    except Exception as e:
+                        logger.warning("pre-save publish hook for "
+                                       "step=%d failed: %s", args[2], e)
                 save_checkpoint(*args)
             except Exception as e:
                 # Log NOW (the failure may otherwise go unnoticed for
@@ -472,7 +503,8 @@ class AsyncCheckpointer:
     def save(self, train_dir: str | Path, state: Any, step: int,
              extra: dict | None = None, keep: int = 5,
              no_skip: bool = False,
-             prepare: Callable[[Any], Any] | None = None) -> None:
+             prepare: Callable[[Any], Any] | None = None,
+             publish: Callable[[Any, int], Any] | None = None) -> None:
         """Queue a write. A single failed write never raises here —
         that already went to the log and a later save may well succeed
         (transient disk pressure); ``wait`` raises if the LAST write
@@ -489,7 +521,14 @@ class AsyncCheckpointer:
         ``prepare``: defer the host snapshot to the worker thread (the
         donation-safe device-snapshot path, class docstring) — the
         caller must pass buffers the step will NOT donate (a fresh
-        device copy)."""
+        device copy).
+
+        ``publish``: sidecar hook ``(prepared_state, step)`` run by
+        the worker BEFORE the artifact/pointer write (the quantized-
+        tier pass rides here so it stays off the step loop AND so a
+        follower that sees the new pointer always finds the sidecar
+        already published); its failures are logged, never surfaced
+        as checkpoint failures (the sidecar is additive)."""
         with self._lock:
             if self._consecutive_failures >= self.max_consecutive_failures:
                 raise RuntimeError(
@@ -513,7 +552,7 @@ class AsyncCheckpointer:
                 logger.warning("checkpoint writer lagging; replacing queued "
                                "step=%d with step=%d", self._pending[2], step)
             self._pending = (train_dir, host_state, step, extra, keep,
-                             prepare)
+                             prepare, publish)
             self._wake.notify_all()
 
     def wait(self) -> None:
@@ -842,6 +881,65 @@ def artifact_digest(train_dir: str | Path, step: int) -> str | None:
     layout) or the artifact is sharded (manifest layout)."""
     train_dir = Path(train_dir)
     dpath = _digest_path(_ckpt_path(train_dir, step))
+    try:
+        return dpath.read_text().strip() or None
+    except OSError:
+        return None
+
+
+def quant_sidecar_path(train_dir: str | Path, step: int) -> Path:
+    """Where a step's quantized-tier sidecar lives (module docstring:
+    the ``.quant.msgpack`` next to the artifact). The ``ckpt-`` prefix
+    keeps it inside the step-grouped GC and the invariant checker's
+    digest sweep; the distinct suffix keeps it OUT of
+    ``_loadable_steps`` — a sidecar alone never makes a step
+    restorable."""
+    return Path(train_dir) / f"ckpt-{step:08d}.quant.msgpack"
+
+
+def write_quant_sidecar(train_dir: str | Path, step: int,
+                        tiers: dict, meta: dict) -> Path:
+    """Atomically publish the quantized tiers for ``step`` (tmp +
+    rename + sha256 digest sidecar — the exact torn-write contract the
+    checkpoint artifact has). ``tiers`` maps tier name → state-dict-
+    shaped param tree; ``meta`` is JSON-serializable provenance (source
+    params digest, calibration record)."""
+    path = quant_sidecar_path(train_dir, step)
+    payload = {"tiers": tiers, "meta": json.dumps(meta)}
+    _write_atomic(path, serialization.msgpack_serialize(payload))
+    return path
+
+
+def read_quant_sidecar(train_dir: str | Path, step: int) -> dict:
+    """Digest-verified read of a step's quant sidecar →
+    ``{"tiers": {...}, "meta": dict}``. Raises ``FileNotFoundError``
+    when no sidecar was published, :class:`CheckpointCorruptError` on
+    a torn payload or sha256 mismatch — both flow into the
+    :class:`CheckpointFollower` skip path, so a serving replica treats
+    a bad sidecar as "fall back to the full-precision artifact", never
+    as a crash and never as something to serve."""
+    path = quant_sidecar_path(train_dir, step)
+    payload = _msgpack_restore_checked(_verified_read(path), path)
+    if not isinstance(payload, dict) or not isinstance(
+            payload.get("tiers"), dict):
+        raise CheckpointCorruptError(
+            f"{path.name}: payload has no 'tiers' entry")
+    meta = payload.get("meta", {})
+    if isinstance(meta, (str, bytes)):
+        try:
+            meta = json.loads(meta)
+        except json.JSONDecodeError as e:
+            raise CheckpointCorruptError(
+                f"{path.name}: torn meta payload ({e})") from e
+    return {"tiers": payload["tiers"], "meta": meta}
+
+
+def quant_sidecar_digest(train_dir: str | Path, step: int) -> str | None:
+    """The recorded sha256 of a step's quant sidecar (its digest
+    sidecar) — what a serving replica journals as the identity of a
+    quantized tier it swapped in. None when no sidecar (or no digest)
+    exists."""
+    dpath = _digest_path(quant_sidecar_path(train_dir, step))
     try:
         return dpath.read_text().strip() or None
     except OSError:

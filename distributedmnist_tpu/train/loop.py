@@ -244,6 +244,22 @@ class Trainer:
                                 and cfg.train.async_snapshot
                                 and not self._sharded_ckpt)
         self._snapshot_fn = None  # jitted un-donated copy, built lazily
+        # Post-training quantization at publish time
+        # (quant.publish_tiers): int8/bf16 serving tiers written as a
+        # digest-verified sidecar next to every cadence save. Built
+        # here so a bad tier name is a typed ConfigError at Trainer
+        # build; the pass itself runs after each save — on the
+        # AsyncCheckpointer worker for async saves, inline otherwise —
+        # and never fails a checkpoint (sidecars are additive).
+        self._quant_publisher = None
+        if cfg.quant.resolved_publish_tiers():
+            from ..parallel.api import abstract_train_params
+            from ..quant.ptq import QuantPublisher
+            self._quant_publisher = QuantPublisher(
+                self.model, cfg,
+                abstract_train_params(self.model, cfg, self.topo),
+                calib_inputs=self.datasets.test.images,
+                calib_labels=self.datasets.test.labels)
         self._sink: JsonlSink | None = None
         # Structured recovery events (NaN rollbacks, corrupt-checkpoint
         # fallbacks, preemption flushes) — the trainer-side half of the
@@ -376,6 +392,15 @@ class Trainer:
         if callable(iter_state) and getattr(self.train_feed, "has_state", True):
             extra["data_iter"] = self.train_feed.state()
         at_step = int(jax.device_get(self.state.step))
+        # quant sidecar publish rides the save — BEFORE the
+        # artifact/pointer write (on the worker thread for async
+        # paths): a follower that sees the pointer name a new step
+        # must find its sidecar already on disk, else a fast poll
+        # falls back to fp32 and never revisits that step's tier
+        publish = None
+        if self._quant_publisher is not None and self.is_writer:
+            pub, tdir = self._quant_publisher, self.train_dir
+            publish = lambda st, s: pub.publish(tdir, st, s)  # noqa: E731
         if self._async_snapshot:
             # donation-safe snapshot, backend-matched (both variants
             # leave the canonical-layout conversion + the state-dict
@@ -410,7 +435,8 @@ class Trainer:
                     canonical_save_state(s, plan)))
             self._checkpointer.save(
                 self.train_dir, snap, at_step, extra=extra,
-                keep=self.cfg.train.keep_checkpoints, prepare=prepare)
+                keep=self.cfg.train.keep_checkpoints, prepare=prepare,
+                publish=publish)
         else:
             # canonical layout on disk: replica-sharded (ZeRO-1)
             # momentum — and resident-sharded params — unpack to their
@@ -430,8 +456,11 @@ class Trainer:
                 self._checkpointer.save(self.train_dir, state_to_save,
                                         at_step, extra=extra,
                                         keep=self.cfg.train.keep_checkpoints,
-                                        no_skip=self._sharded_ckpt)
+                                        no_skip=self._sharded_ckpt,
+                                        publish=publish)
             else:
+                if publish is not None:
+                    publish(state_to_save, at_step)
                 ckpt.save_checkpoint(self.train_dir, state_to_save, at_step,
                                      extra=extra,
                                      keep=self.cfg.train.keep_checkpoints)
@@ -446,7 +475,10 @@ class Trainer:
         self._sink_write({"event": "save", "time": time.time(),
                           "at_step": at_step,
                           "save_stall_ms": round(stall_ms, 3),
-                          "async_snapshot": self._async_snapshot})
+                          "async_snapshot": self._async_snapshot,
+                          **({"quant_tiers":
+                              list(self._quant_publisher.tiers)}
+                             if publish is not None else {})})
         self._last_save_time = time.time()
 
     def _rollback_to_last_good(self, err: _NonFiniteLoss) -> int:

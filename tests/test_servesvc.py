@@ -294,8 +294,161 @@ def test_client_fails_over_and_deadline(published, tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# quantized precision tiers (serve.precision_tier + the quant sidecar)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def quant_published(tmp_path_factory, synthetic_datasets):
+    """Like ``published`` but the trainer also writes int8 sidecars —
+    the tier-preference scenarios publish from here."""
+    staging = tmp_path_factory.mktemp("qstaging")
+    cfg = base_config(train={"train_dir": str(staging), "max_steps": 30,
+                             "log_every_steps": 10,
+                             "save_interval_steps": 10},
+                      quant={"publish_tiers": "int8",
+                             "calibration_examples": 32})
+    from distributedmnist_tpu.train.loop import Trainer
+    Trainer(cfg, datasets=synthetic_datasets).run()
+    return {"staging": staging, "cfg": cfg}
+
+
+def publish_quant_step(staging: Path, serve_dir: Path, step: int,
+                       with_sidecar: bool = True,
+                       tear_sidecar: bool = False) -> None:
+    """publish_step plus the quant sidecar family; ``tear_sidecar``
+    truncates the sidecar AFTER the copy (its digest stays intact) —
+    the torn-sidecar scenario digest verification must refuse."""
+    publish_step(staging, serve_dir, step)
+    if not with_sidecar:
+        return
+    qname = f"ckpt-{step:08d}.quant.msgpack"
+    shutil.copy2(staging / qname, serve_dir / qname)
+    shutil.copy2(staging / (qname + ".sha256"),
+                 serve_dir / (qname + ".sha256"))
+    if tear_sidecar:
+        data = (serve_dir / qname).read_bytes()
+        (serve_dir / qname).write_bytes(data[:max(1, len(data) // 2)])
+
+
+def test_int8_tier_preferred_and_meta_reports_it(quant_published,
+                                                 tmp_path):
+    """A replica on precision_tier=int8 installs the sidecar tier, the
+    weight_swap journals tier + source identity, responses carry the
+    tier, and the meta probe reports active tier + source digest (what
+    loadgen artifacts record a sweep actually measured)."""
+    from distributedmnist_tpu.core.config import ServeConfig
+    from distributedmnist_tpu.servesvc.server import ServingReplica
+    from distributedmnist_tpu.train import checkpoint as ckpt
+    serve_src = tmp_path / "publish"
+    publish_quant_step(quant_published["staging"], serve_src, 10)
+    rep = ServingReplica(serve_src, serve_dir=tmp_path / "replica",
+                         scfg=ServeConfig(poll_secs=0.05,
+                                          precision_tier="int8"),
+                         cfg=quant_published["cfg"])
+    rep.start()
+    try:
+        make_input = sample_input(quant_published)
+        out = raw_request(rep.bound_port, {"id": 1,
+                                           "inputs": make_input(1)})
+        assert out["status"] == "ok" and out["model_step"] == 10
+        assert out["tier"] == "int8"
+        meta = raw_request(rep.bound_port, {"meta": True})
+        assert meta["precision_tier"] == "int8"
+        assert meta["active_tier"] == "int8"
+        src = ckpt.read_quant_sidecar(serve_src, 10)["meta"][
+            "source_params_digest"]
+        assert meta["tier_source_digest"] == src
+        assert meta["model_digest"] == ckpt.quant_sidecar_digest(
+            serve_src, 10)
+    finally:
+        rep.stop()
+    swaps = [r for r in serve_records(rep)
+             if r.get("action") == "weight_swap"]
+    assert [(s["step"], s["tier"], s["source_artifact"])
+            for s in swaps] == [(10, "int8",
+                                 "ckpt-00000010.quant.msgpack")]
+    assert swaps[0]["source_digest"] == src
+
+
+def test_torn_sidecar_falls_back_to_fp32_without_wedge(quant_published,
+                                                       tmp_path):
+    """Satellite: a TORN sidecar journals
+    ``follow_quant_sidecar_fallback`` and that publish serves full
+    precision — the follower cursor advances (no skip-loop re-read
+    wedge), and the NEXT good publish upgrades back to int8."""
+    from distributedmnist_tpu.core.config import ServeConfig
+    from distributedmnist_tpu.servesvc.server import ServingReplica
+    serve_src = tmp_path / "publish"
+    publish_quant_step(quant_published["staging"], serve_src, 10,
+                       tear_sidecar=True)
+    rep = ServingReplica(serve_src, serve_dir=tmp_path / "replica",
+                         scfg=ServeConfig(poll_secs=0.05,
+                                          precision_tier="int8"),
+                         cfg=quant_published["cfg"])
+    rep.start()
+    try:
+        make_input = sample_input(quant_published)
+        out = raw_request(rep.bound_port, {"id": 1,
+                                           "inputs": make_input(1)})
+        assert out["status"] == "ok" and out["model_step"] == 10
+        assert out["tier"] == "fp32"  # the fallback, never torn bytes
+        # the cursor CONSUMED step 10 through the fp32 path — several
+        # polls later there is still exactly ONE fallback journaled
+        time.sleep(0.4)
+        recs = serve_records(rep)
+        fallbacks = [r for r in recs
+                     if r.get("action") == "follow_quant_sidecar_fallback"]
+        assert len(fallbacks) == 1, fallbacks
+        assert fallbacks[0]["step"] == 10
+        assert "CheckpointCorruptError" in fallbacks[0]["reason"]
+        # a sidecar-less publish falls back too (journaled as absent)…
+        publish_quant_step(quant_published["staging"], serve_src, 20,
+                           with_sidecar=False)
+        deadline = time.time() + 30
+        while rep.model_step < 20 and time.time() < deadline:
+            time.sleep(0.05)
+        assert rep.model_step == 20 and rep.model_tier == "fp32"
+        # …and the next GOOD sidecar restores the quantized tier
+        publish_quant_step(quant_published["staging"], serve_src, 30)
+        while rep.model_step < 30 and time.time() < deadline:
+            time.sleep(0.05)
+        assert rep.model_step == 30 and rep.model_tier == "int8"
+    finally:
+        rep.stop()
+    recs = serve_records(rep)
+    swaps = [(r["step"], r["tier"]) for r in recs
+             if r.get("action") == "weight_swap"]
+    assert swaps == [(10, "fp32"), (20, "fp32"), (30, "int8")]
+    reasons = [r["reason"].split(":")[0] for r in recs
+               if r.get("action") == "follow_quant_sidecar_fallback"]
+    assert reasons == ["CheckpointCorruptError", "sidecar_absent"]
+
+
+# ---------------------------------------------------------------------------
 # serving chaos schedule grammar
 # ---------------------------------------------------------------------------
+
+@pytest.mark.tier1
+def test_chaos_serving_tier_payload_wiring():
+    """serve_precision_tiers pins replica tiers AND arms the publisher
+    with the matching quant.publish_tiers; tier-less configs keep the
+    byte-identical historical payloads."""
+    from distributedmnist_tpu.launch.chaos import ChaosConfig
+    cfg = ChaosConfig(payload="serving", serve_replicas=2,
+                      serve_precision_tiers=("int8",))
+    cmds = cfg.resolved_worker_commands()
+    assert "--precision-tier int8" in cmds["1"]
+    assert "--precision-tier" not in cmds["2"]
+    assert "quant.publish_tiers=int8" in cfg.resolved_train_command()
+    plain = ChaosConfig(payload="serving", serve_replicas=2)
+    assert "--precision-tier" not in plain.resolved_worker_commands()["1"]
+    assert "quant.publish_tiers" not in plain.resolved_train_command()
+    # a typo'd tier fails typed at config build, naming the valid set —
+    # not as a replica crash-looping against its restart budget
+    from distributedmnist_tpu.launch.cluster import ClusterError
+    with pytest.raises(ClusterError, match="in8.*valid tiers"):
+        ChaosConfig(payload="serving", serve_precision_tiers=("in8",))
+
 
 @pytest.mark.tier1
 def test_serving_schedule_grammar_and_determinism():
