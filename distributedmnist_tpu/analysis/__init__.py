@@ -1,0 +1,30 @@
+"""graftcheck — contract-aware static analysis for jax_graft.
+
+A pytest-free, import-free AST toolchain (``python -m
+distributedmnist_tpu.analysis``) that moves contract violations from
+chaos-campaign time to CI time.  Four checkers:
+
+* ``schema``  — every journal emit site's literal payload verified
+  against the ``obsv/schema.py`` event registry (reader/emitter drift
+  becomes a CI failure, not a replay KeyError);
+* ``config``  — every ``cfg.<section>.<field>`` access resolves to a
+  declared dataclass field in ``core/config.py``; declared knobs never
+  read anywhere are flagged dead;
+* ``threads`` — instance attributes written from more than one
+  thread-entry reachability root without a lock guard;
+* ``jax``     — donated-buffer reuse after a donating jitted call,
+  host-syncing ``.item()``/``float()`` inside step/batcher loops, and
+  Python-scalar jit signatures that force per-value recompiles.
+
+Never imports the analyzed modules (no jax required): everything is
+``ast.parse`` over source.  Findings are machine-readable JSON;
+known-accepted findings live in ``analysis/baseline.json`` with a
+one-line justification each — legacy findings are explicit, never
+silent.
+"""
+
+from .core import (Finding, iter_sources, load_baseline, run_checkers,
+                   CHECKERS)
+
+__all__ = ["Finding", "iter_sources", "load_baseline", "run_checkers",
+           "CHECKERS"]

@@ -1,0 +1,288 @@
+"""graftcheck ``jax``: the JAX-hazard lint.
+
+Three hazards the type system can't see and the test suite only hits
+when the wrong interleaving/shape shows up:
+
+* **donated-buffer reuse** — after calling a jitted function built
+  with ``donate_argnums``/``donate_argnames``, the donated operand's
+  buffer is dead; reading the same variable afterwards (or around a
+  loop without rebinding it) is use-after-donate, which jax surfaces
+  as a runtime error only on backends that actually alias.
+* **host sync in hot loops** — ``.item()`` (and ``float()``/``int()``
+  over values produced by a jitted call in the same loop) inside a
+  ``for``/``while`` in step/batch/loop/run-shaped functions blocks the
+  dispatch queue every iteration — the async-dispatch overlap the
+  step loop is built around silently degrades to lockstep.
+* **python-scalar jit signature** — passing an enclosing loop's
+  induction variable positionally to a jitted callable with no
+  ``static_argnums``/``static_argnames`` recompiles per value (a
+  Python int is a new constant each trace).
+
+Jitted callables are resolved module-locally: names (or ``self.x``
+attributes) bound from a ``jit(...)``/``jax.jit(...)`` call.  Cross-
+module donation tracking is out of scope — the fixture tests pin the
+in-module contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+
+from .core import (Finding, Source, add_parents, enclosing, make_key,
+                   register)
+
+_HOT_NAME = re.compile(r"step|batch|loop|run", re.IGNORECASE)
+
+
+@dataclasses.dataclass
+class Jitted:
+    name: str            # bound name ("f" or "self.f" normalized to f)
+    donating: bool
+    has_static: bool
+    # positional indices donate_argnums names, when statically
+    # readable; None with donating=True means "unknown positions" (a
+    # computed argnums expression, or donate_argnames whose positions
+    # the AST can't map without the signature) — all args assumed
+    donate_positions: tuple[int, ...] | None = None
+
+
+def _callee_name(call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _bound_name(target: ast.expr) -> str | None:
+    if isinstance(target, ast.Name):
+        return target.id
+    if isinstance(target, ast.Attribute):
+        return target.attr  # self._step → "_step"
+    return None
+
+
+def _collect_jitted(src: Source) -> dict[str, Jitted]:
+    out: dict[str, Jitted] = {}
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        call = node.value
+        if not (isinstance(call, ast.Call)
+                and _callee_name(call) == "jit"):
+            continue
+        kwargs = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+        donating = bool(set(kwargs) & {"donate_argnums",
+                                       "donate_argnames"})
+        has_static = any(k.startswith("static_arg") for k in kwargs)
+        positions: tuple[int, ...] | None = None
+        argnums = kwargs.get("donate_argnums")
+        if argnums is not None and "donate_argnames" not in kwargs:
+            if (isinstance(argnums, ast.Constant)
+                    and isinstance(argnums.value, int)):
+                positions = (argnums.value,)
+            elif (isinstance(argnums, (ast.Tuple, ast.List))
+                  and all(isinstance(e, ast.Constant)
+                          and isinstance(e.value, int)
+                          for e in argnums.elts)):
+                positions = tuple(e.value for e in argnums.elts)
+        for t in node.targets:
+            name = _bound_name(t)
+            if name:
+                out[name] = Jitted(name, donating, has_static,
+                                   positions)
+    return out
+
+
+def _donated_args(call: ast.Call, j: Jitted) -> list[ast.expr]:
+    if j.donate_positions is None:
+        return list(call.args)
+    return [a for i, a in enumerate(call.args)
+            if i in j.donate_positions]
+
+
+def _call_of(node: ast.expr, jitted: dict[str, Jitted]
+             ) -> Jitted | None:
+    if not isinstance(node, ast.Call):
+        return None
+    name = _callee_name(node)
+    return jitted.get(name or "")
+
+
+def _names_read(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _names_bound(node: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            out.add(n.id)
+    return out
+
+
+def _check_donation(src: Source, fn: ast.FunctionDef,
+                    jitted: dict[str, Jitted],
+                    out: list[Finding]) -> None:
+    """Linear scan of each statement list: after a donating call whose
+    positional args are plain names, those names are dead until
+    rebound."""
+
+    def scan(body: list[ast.stmt]) -> None:
+        dead: dict[str, int] = {}  # name -> donate line
+        for stmt in body:
+            # reads in this statement of names donated by a PRIOR
+            # sibling statement
+            reads = _names_read(stmt)
+            for name in sorted(reads & set(dead)):
+                out.append(Finding(
+                    "jax", src.path, stmt.lineno,
+                    make_key("jax", src.path,
+                             f"donate.{fn.name}.{name}"),
+                    f"{name!r} is read at line {stmt.lineno} after "
+                    f"being donated to a jitted call at line "
+                    f"{dead[name]} in {fn.name}() — the buffer is "
+                    "dead (use-after-donate)"))
+                del dead[name]
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign,
+                                 ast.AugAssign, ast.Expr)):
+                # only simple statements propagate donations to their
+                # siblings: a donation inside an If branch that
+                # returns, or in a Return itself, never flows here
+                for node in ast.walk(stmt):
+                    j = _call_of(node, jitted)
+                    if j is not None and j.donating:
+                        for arg in _donated_args(node, j):
+                            if isinstance(arg, ast.Name):
+                                dead.setdefault(arg.id, node.lineno)
+            elif isinstance(stmt, ast.If):
+                scan(stmt.body)
+                scan(stmt.orelse)
+            elif isinstance(stmt, ast.With):
+                scan(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                for b in (stmt.body, stmt.orelse, stmt.finalbody):
+                    scan(b)
+                for h in stmt.handlers:
+                    scan(h.body)
+            elif isinstance(stmt, (ast.For, ast.While)):
+                # a donation inside the loop must rebind its operand
+                # within the same iteration, else the next iteration
+                # reads a dead buffer
+                bound = _names_bound(stmt)
+                for node in ast.walk(stmt):
+                    j = _call_of(node, jitted)
+                    if j is None or not j.donating:
+                        continue
+                    for arg in _donated_args(node, j):
+                        if (isinstance(arg, ast.Name)
+                                and arg.id not in bound):
+                            out.append(Finding(
+                                "jax", src.path, node.lineno,
+                                make_key("jax", src.path,
+                                         f"donate-loop.{fn.name}."
+                                         f"{arg.id}"),
+                                f"{arg.id!r} is donated to a jitted "
+                                f"call inside a loop in {fn.name}() "
+                                "but never rebound in the loop body — "
+                                "the next iteration reads a dead "
+                                "buffer"))
+            # rebinds revive the name AFTER same-statement donations:
+            # `state = f(state)` donates the old buffer, then binds
+            # the name to the fresh result
+            for name in _names_bound(stmt) & set(dead):
+                del dead[name]
+
+    scan(fn.body)
+
+
+def _check_host_sync(src: Source, fn: ast.FunctionDef,
+                     jitted: dict[str, Jitted],
+                     out: list[Finding]) -> None:
+    if not _HOT_NAME.search(fn.name):
+        return
+    for loop in ast.walk(fn):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        # names assigned from jitted calls inside this loop: their
+        # values live on device
+        device_names: set[str] = set()
+        for node in ast.walk(loop):
+            if (isinstance(node, ast.Assign)
+                    and _call_of(node.value, jitted) is not None):
+                device_names |= _names_bound(node)
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item"):
+                out.append(Finding(
+                    "jax", src.path, node.lineno,
+                    make_key("jax", src.path,
+                             f"host-sync.{fn.name}.item"),
+                    f".item() inside the loop in {fn.name}() blocks "
+                    "on device completion every iteration — hoist the "
+                    "fetch to the flush cadence"))
+            elif (isinstance(node.func, ast.Name)
+                  and node.func.id in ("float", "int")
+                  and node.args
+                  and _names_read(node.args[0]) & device_names):
+                out.append(Finding(
+                    "jax", src.path, node.lineno,
+                    make_key("jax", src.path,
+                             f"host-sync.{fn.name}."
+                             f"{node.func.id}"),
+                    f"{node.func.id}() over a jitted-call result "
+                    f"inside the loop in {fn.name}() forces a device "
+                    "sync every iteration"))
+
+
+def _check_scalar_signature(src: Source, fn: ast.FunctionDef,
+                            jitted: dict[str, Jitted],
+                            out: list[Finding]) -> None:
+    for node in ast.walk(fn):
+        j = _call_of(node, jitted)
+        if j is None or j.has_static:
+            continue
+        loop = enclosing(node, ast.For)
+        if loop is None or not isinstance(loop.target, ast.Name):
+            continue
+        # only range()-style loops: their induction variable is a
+        # Python scalar (a new traced constant per value); iterating
+        # device arrays/batches is not this hazard
+        if not (isinstance(loop.iter, ast.Call)
+                and _callee_name(loop.iter) in ("range", "enumerate")):
+            continue
+        loop_var = loop.target.id
+        for arg in node.args:
+            if isinstance(arg, ast.Name) and arg.id == loop_var:
+                out.append(Finding(
+                    "jax", src.path, node.lineno,
+                    make_key("jax", src.path,
+                             f"scalar-jit.{fn.name}.{arg.id}"),
+                    f"loop variable {arg.id!r} is passed positionally "
+                    f"to jitted {j.name!r} in {fn.name}() with no "
+                    "static_argnums — every value traces a new "
+                    "program (recompile per iteration)"))
+
+
+@register("jax")
+def check(sources: list[Source]) -> list[Finding]:
+    out: list[Finding] = []
+    for src in sources:
+        if src.is_test:
+            continue
+        add_parents(src.tree)
+        jitted = _collect_jitted(src)
+        if not jitted:
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.FunctionDef):
+                _check_donation(src, node, jitted, out)
+                _check_host_sync(src, node, jitted, out)
+                _check_scalar_signature(src, node, jitted, out)
+    return out

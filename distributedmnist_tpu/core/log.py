@@ -17,6 +17,12 @@ import time
 from pathlib import Path
 from typing import Any, IO
 
+# Sampled once, on the first write: the gate is a test-harness/debug
+# switch, not a runtime toggle, and the write path is hot (per-step
+# records). The parse itself lives in ONE place —
+# obsv.schema.validation_enabled — so every enforcement point agrees.
+_VALIDATE_EVENTS: bool | None = None
+
 _LOGGER = logging.getLogger("distributedmnist_tpu")
 if not _LOGGER.handlers:
     _h = logging.StreamHandler(sys.stderr)
@@ -40,6 +46,19 @@ class JsonlSink:
 
     def write(self, record: dict[str, Any]) -> None:
         record.setdefault("ts", time.time())
+        global _VALIDATE_EVENTS
+        if _VALIDATE_EVENTS is None:
+            from ..obsv.schema import validation_enabled
+            _VALIDATE_EVENTS = validation_enabled()
+        if _VALIDATE_EVENTS:
+            # debug-mode journal-schema enforcement (on in tests): the
+            # runtime half of graftcheck — payloads built dynamically
+            # (**fields, loops) that the AST pass can't see as literal
+            # dicts still get checked against obsv/schema.py before
+            # they land in an artifact.  Records without an "event" key
+            # (sweep-result rows share this sink) pass vacuously.
+            from ..obsv.schema import check_event
+            check_event(record, source=self.path.name)
         self._fh.write(json.dumps(record, default=_default) + "\n")
 
     def close(self) -> None:

@@ -45,6 +45,7 @@ from typing import Any
 
 from ..core.log import get_logger
 from ..obsv.invariants import check_run, shrink_faults
+from ..obsv.schema import maybe_check_event
 from .cluster import (ClusterError, LocalClusterConfig, LocalProcessCluster,
                       worker_logged_since_spawn,
                       worker_resumed_step_since_spawn)
@@ -991,6 +992,9 @@ class ChaosCampaign:
                 rec["shrunk"] = shrunk
                 reproducer = shrunk
             records.append(rec)
+            # the one journal write that bypasses JsonlSink: same
+            # debug-gated schema enforcement (obsv/schema.py)
+            maybe_check_event(rec, source="chaos_report.jsonl")
             with open(report_path, "a") as fh:
                 fh.write(json.dumps(rec, default=str) + "\n")
 

@@ -1,5 +1,7 @@
 from .journal import load_journal, summarize_journal
+from .schema import EventSchemaError, check_event, validate_event
 from .timing import CdfStats, StepTimeCollector, compute_stats
 
-__all__ = ["CdfStats", "StepTimeCollector", "compute_stats",
-           "load_journal", "summarize_journal"]
+__all__ = ["CdfStats", "EventSchemaError", "StepTimeCollector",
+           "check_event", "compute_stats", "load_journal",
+           "summarize_journal", "validate_event"]

@@ -45,6 +45,12 @@ No cluster, supervisor, or trainer state is consulted — a report over
 downloaded artifacts is as checkable as a live run, which is what lets
 the chaos campaign shrink failing schedules by re-running and
 re-checking mechanically.
+
+The event vocabulary this module filters on (kinds, actions, required
+fields) is declared ONCE in ``obsv/schema.py`` — the same registry the
+emitters are checked against by graftcheck
+(``distributedmnist_tpu.analysis``), so reader and writer cannot
+drift.
 """
 
 from __future__ import annotations
@@ -54,6 +60,7 @@ import json
 from pathlib import Path
 from typing import Any, Callable
 
+from . import schema
 from .report import load_jsonl
 
 INVARIANTS = ("terminal_state", "metrics_log", "determinism",
@@ -368,7 +375,7 @@ def check_reconfigure(trial_dir: str | Path, outcome: dict,
     artifacts show."""
     trial_dir = Path(trial_dir)
     reconf = [r for r in journal_records
-              if r.get("event") == "reconfigure"]
+              if r.get("event") == schema.RECONFIGURE]
     reshapes = [r for r in reconf if r.get("action") == "reshape"]
     relaunches = [r for r in reconf if r.get("action") == "relaunched"]
     grown = {int(k) for r in reshapes for k in (r.get("grown") or {})}
@@ -477,7 +484,7 @@ def check_serving(trial_dir: str | Path, outcome: dict,
     out: list[Violation] = []
 
     # ---- (a) client side: issued ↔ exactly-one-terminal ----------------
-    load_records = load_jsonl(loadgen, "load")
+    load_records = load_jsonl(loadgen, schema.LOAD)
     issued: dict[Any, int] = {}
     terminal: dict[Any, int] = {}
     for r in load_records:
@@ -502,15 +509,15 @@ def check_serving(trial_dir: str | Path, outcome: dict,
     # admissions may legitimately have died server-side
     exempt: set[int] = set()
     for r in journal_records:
-        if r.get("event") == "fault" and isinstance(r.get("worker"), int):
+        if r.get("event") == schema.FAULT and isinstance(r.get("worker"), int):
             exempt.add(r["worker"])
-        if (r.get("event") == "recovery" and r.get("action") == "restart"
+        if (r.get("event") == schema.RECOVERY and r.get("action") == "restart"
                 and isinstance(r.get("worker"), int)):
             exempt.add(r["worker"])
 
     corrupt_faults = [
         r for r in journal_records
-        if r.get("event") == "fault"
+        if r.get("event") == schema.FAULT
         and r.get("action") == "corrupt_latest_checkpoint"
         and r.get("target")]
 
@@ -519,7 +526,7 @@ def check_serving(trial_dir: str | Path, outcome: dict,
         d = workers.get(k)
         if d is None:
             continue
-        recs = load_jsonl(d / "serve_log.jsonl", "serve")
+        recs = load_jsonl(d / "serve_log.jsonl", schema.SERVE)
         if not recs:
             out.append(Violation(
                 "serve_outcomes", "serving replica left no serve journal "
@@ -619,7 +626,7 @@ def corruption_exempt_targets(journal_records: list[dict]
     deliberately torn — exempt from invariant (5)."""
     out: dict[int, set[str]] = {}
     for r in journal_records:
-        if (r.get("event") == "fault"
+        if (r.get("event") == schema.FAULT
                 and r.get("action") == "corrupt_latest_checkpoint"
                 and r.get("target")):
             out.setdefault(r.get("worker", -1), set()).add(r["target"])
@@ -644,9 +651,10 @@ def check_run(trial_dir: str | Path, outcome: dict | None = None,
         reference_dir = outcome["reference_dir"]
 
     journal_all = load_jsonl(trial_dir / "command_journal.jsonl")
-    recovery = [r for r in journal_all if r.get("event") == "recovery"]
+    recovery = [r for r in journal_all if r.get("event") == schema.RECOVERY]
     workers = _worker_dirs(trial_dir)
-    worker_events = {k: load_jsonl(d / "recovery_journal.jsonl", "recovery")
+    worker_events = {k: load_jsonl(d / "recovery_journal.jsonl",
+                                   schema.RECOVERY)
                      for k, d in workers.items()}
     exempt = corruption_exempt_targets(journal_all)
 
@@ -702,7 +710,7 @@ def check_run(trial_dir: str | Path, outcome: dict | None = None,
         # {"step": N, ...} records — both are the metrics series
         steps = [r for r in load_jsonl(d / "train_log.jsonl")
                  if isinstance(r.get("step"), int)
-                 and r.get("event", "step") == "step"]
+                 and r.get("event", schema.STEP) == schema.STEP]
         if k in grown and not steps:
             # a grown worker that never produced a step before
             # teardown has nothing to splice — its resume evidence is

@@ -13,19 +13,20 @@ from __future__ import annotations
 from pathlib import Path
 from typing import Any
 
+from . import schema
 from .report import load_jsonl
 
 
 def load_journal(path: str | Path) -> list[dict]:
     """Command records from a journal (tolerates a torn tail write)."""
-    return load_jsonl(path, event="command")
+    return load_jsonl(path, event=schema.COMMAND)
 
 
 def load_recovery_events(path: str | Path) -> list[dict]:
     """Structured recovery records (``event: "recovery"``) — written by
     the supervisor into the command journal and by the trainer /
     checkpoint layer into ``train_dir/recovery_journal.jsonl``."""
-    return load_jsonl(path, event="recovery")
+    return load_jsonl(path, event=schema.RECOVERY)
 
 
 def load_reconfigure_events(path: str | Path) -> list[dict]:
@@ -35,7 +36,7 @@ def load_reconfigure_events(path: str | Path) -> list[dict]:
     is the causal LICENSE for a world change: the cross-world resume
     invariant (obsv/invariants.py) fails a run whose world silently
     changed shape without one."""
-    return load_jsonl(path, event="reconfigure")
+    return load_jsonl(path, event=schema.RECONFIGURE)
 
 
 def summarize_reconfigure_events(records: list[dict]) -> dict[str, Any]:
@@ -51,18 +52,17 @@ def summarize_reconfigure_events(records: list[dict]) -> dict[str, Any]:
     for r in records:
         a = r.get("action")
         if a == "begin":
-            cur = {"old_world": r.get("old_world"),
-                   "new_world": r.get("new_world"),
-                   "trigger": r.get("trigger"),
-                   "quorum": r.get("quorum"),
-                   "effective_quorum": r.get("effective_quorum"),
-                   "survivors": r.get("survivors")}
+            # the schema registry IS the field list: every required
+            # begin field lands in the transition, so emitter and
+            # summarizer can't drift
+            cur = {k: r.get(k) for k in schema.required_fields(
+                schema.RECONFIGURE, "begin")}
             transitions.append(cur)
         elif a == "reshape" and cur is None:
-            transitions.append({"old_world": r.get("old_world"),
-                                "new_world": r.get("new_world"),
-                                "trigger": "backend",
-                                "grown": r.get("grown")})
+            t = {k: r.get(k) for k in schema.required_fields(
+                schema.RECONFIGURE, "reshape")}
+            t["trigger"] = "backend"
+            transitions.append(t)
         elif a == "relaunched" and cur is not None:
             cur["drain_s"] = r.get("drain_s")
             cur["via"] = r.get("via")
@@ -193,9 +193,8 @@ def summarize_recovery_events(records: list[dict]) -> dict[str, Any]:
         if "worker" in rec:
             by_worker.setdefault(rec["worker"], []).append(action)
         if action == "quorum_transition":
-            quorum.append({k: rec.get(k) for k in
-                           ("workers_alive", "num_workers", "quorum",
-                            "degraded")})
+            quorum.append({k: rec.get(k) for k in schema.required_fields(
+                schema.RECOVERY, "quorum_transition")})
         if action == "resume" and "worker" in rec:
             resume_steps[rec["worker"]] = rec.get("step")
     return {"events": len(records), "by_action": by_action,
@@ -217,7 +216,7 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
     violated what, and any shrunk reproducer paths. ``all_green`` means
     every trial passed every applicable invariant — the regression
     signal a scheduled chaos sweep gates on."""
-    records = load_jsonl(path, event="chaos_trial")
+    records = load_jsonl(path, event=schema.CHAOS_TRIAL)
     outcomes: dict[str, int] = {}
     by_invariant: dict[str, dict[str, int]] = {}
     failing: list[dict[str, Any]] = []

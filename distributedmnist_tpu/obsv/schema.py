@@ -1,0 +1,440 @@
+"""Journal-event schema registry — the single source of truth for what
+every journaled record carries.
+
+Thirteen PRs grew seven-plus journaled event contracts (command,
+recovery, reconfigure, serve, step/save/compile, heartbeat, load,
+fault, lifecycle, spawn, chaos_trial, eval) with the emitter side
+(``launch/exec.py``, ``launch/supervisor.py``, ``train/loop.py``,
+``servesvc/server.py``, …) and the reader side (``obsv/journal.py``
+summarizers, ``obsv/invariants.py`` replay checks) each keeping their
+own implicit field lists.  Drift between them — a save event writing
+``at_step`` while a reader expects ``step``, a summarizer KeyError-ing
+on a legacy tier-less swap — surfaced at chaos-campaign time or never.
+
+This module is the mechanical contract both sides import:
+
+* every event KIND is declared once, with its required fields (present
+  at every emit site) and optional fields (present at some);
+* kinds with an ``action`` axis (recovery, serve, …) declare the
+  per-action payload the same way;
+* ``obsv/journal.py`` and ``obsv/invariants.py`` project records
+  through :func:`required_fields` / the kind constants below instead
+  of re-listing field names;
+* the static analysis pass (``distributedmnist_tpu.analysis``,
+  "graftcheck") resolves every emit site at CI time and verifies
+  literal payloads against this registry;
+* :func:`validate_event` is the runtime half for payloads the AST pass
+  cannot see (``**fields`` expansions, dicts built in loops) — wired
+  into :class:`core.log.JsonlSink` behind the ``DMT_VALIDATE_EVENTS``
+  env gate, on in tests, off in production hot paths.
+
+Readers stay tolerant of LEGACY journals (replaying old artifacts must
+never crash); the registry governs what the CURRENT tree is allowed to
+WRITE.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Mapping
+
+# -- canonical event-kind names (import these, don't re-spell them) ------
+COMMAND = "command"
+RECOVERY = "recovery"
+RECONFIGURE = "reconfigure"
+SERVE = "serve"
+STEP = "step"
+SAVE = "save"
+COMPILE = "compile"
+HEARTBEAT = "heartbeat"
+LOAD = "load"
+FAULT = "fault"
+LIFECYCLE = "lifecycle"
+SPAWN = "spawn"
+CHAOS_TRIAL = "chaos_trial"
+EVAL = "eval"
+
+# Fields any journaled record may carry regardless of kind: the sink
+# stamps ``ts``, emitters stamp ``time``, the supervisor stamps ``seed``
+# on everything it records, and multi-layer emitters tag ``layer``.
+ENVELOPE_FIELDS = ("event", "ts", "time", "seed", "layer")
+
+
+class EventSchemaError(ValueError):
+    """A journaled record violates its declared event schema."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ActionSchema:
+    """Payload contract for one ``action`` of an event kind."""
+
+    required: tuple[str, ...] = ()
+    optional: tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class EventSchema:
+    """Payload contract for one event kind.
+
+    ``required``/``optional`` apply to every record of the kind;
+    ``actions`` (when the kind has an action axis) adds per-action
+    fields on top.  ``open_payload`` marks kinds whose payload is
+    legitimately dynamic (e.g. ``compile`` carries whatever the AOT
+    cache measured) — unknown keys are allowed, required keys still
+    checked."""
+
+    kind: str
+    required: tuple[str, ...] = ()
+    optional: tuple[str, ...] = ()
+    actions: Mapping[str, ActionSchema] | None = None
+    open_payload: bool = False
+
+
+def _act(required: tuple[str, ...] = (),
+         optional: tuple[str, ...] = ()) -> ActionSchema:
+    return ActionSchema(required=required, optional=optional)
+
+
+EVENT_SCHEMAS: dict[str, EventSchema] = {}
+
+
+def _declare(schema: EventSchema) -> None:
+    EVENT_SCHEMAS[schema.kind] = schema
+
+
+# ---------------------------------------------------------------------------
+# the registry
+# ---------------------------------------------------------------------------
+
+# launch/exec.py Executor.run / journal: one record per command attempt.
+_declare(EventSchema(
+    COMMAND,
+    required=("verb", "argv"),
+    optional=("rc", "duration_ms", "attempt", "check", "timed_out",
+              "injected", "injected_delay_ms", "stdout_tail",
+              "stderr_tail", "will_retry", "dry_run", "error"),
+))
+
+# Recovery episodes: supervisor detect/restart/resume chain
+# (launch/supervisor.py), trainer self-healing (train/loop.py), and the
+# checkpoint layer's fallback events (train/checkpoint.py,
+# parallel/api.py) — all land as ``event: "recovery"`` records in the
+# command journal and/or ``recovery_journal.jsonl``.
+_declare(EventSchema(
+    RECOVERY,
+    required=("action",),
+    optional=("worker",),
+    actions={
+        "detect": _act(("worker", "kind"), ("at_step", "stalled_at")),
+        "restart_scheduled": _act(("worker", "attempt", "backoff_s")),
+        "restart": _act(("worker", "attempt", "at_step", "via"),
+                        ("detected_at", "respawn_s")),
+        "restart_budget_exhausted": _act(("worker", "restarts"),
+                                         ("reason",)),
+        "resume": _act(("worker",),
+                       ("step", "detected_at", "mttr_s", "respawned_at",
+                        "resume_after_respawn_s")),
+        "episode_superseded": _act(("worker", "by", "trigger")),
+        "target_reached": _act(("step",)),
+        "quorum_transition": _act(("workers_alive", "num_workers",
+                                   "quorum", "degraded")),
+        "below_quorum_abort": _act(("workers_alive", "quorum")),
+        "standbys_requested": _act(("count",)),
+        "standbys_unavailable": _act(("error",)),
+        # trainer self-healing (train/loop.py)
+        "nonfinite_loss_detected": _act(("step", "loss")),
+        "nan_rollback": _act(("from_step", "to_step", "loss")),
+        "rollback_candidate_unusable": _act(("step", "error")),
+        "rollback_candidate_poisoned": _act(("step",)),
+        "preempt_flush": _act(("signal", "step")),
+        # checkpoint layer (train/checkpoint.py, parallel/api.py)
+        "follow_skip": _act(("step", "error")),
+        "corrupt_checkpoint_fallback": _act(("bad_step", "error")),
+        "fallback_restore": _act(("step",)),
+        "cross_world_restore": _act(("step", "saved_world",
+                                     "new_world")),
+    },
+))
+
+# Elastic world reshapes — the causal LICENSE the cross-world resume
+# invariant requires (launch/supervisor.py begin/relaunched/resume,
+# launch/cluster.py reshape).
+_declare(EventSchema(
+    RECONFIGURE,
+    required=("action",),
+    actions={
+        "begin": _act(("old_world", "new_world", "trigger", "quorum",
+                       "effective_quorum", "survivors")),
+        "reshape": _act(("old_world", "new_world", "old_workers",
+                         "workers", "dropped", "grown")),
+        "relaunched": _act(("old_world", "new_world", "trigger",
+                            "drain_s", "workers", "via", "grown")),
+        "resume": _act(("worker", "step", "old_world", "new_world",
+                        "trigger", "reconfigure_s")),
+    },
+))
+
+# Serving-replica journal (servesvc/server.py serve_log.jsonl).  The
+# ``follow_*`` actions are the checkpoint follower's restore events
+# re-journaled with their serve-side prefix.
+_declare(EventSchema(
+    SERVE,
+    required=("action",),
+    actions={
+        "serve_start": _act(("port", "model_step", "precision_tier",
+                             "active_tier", "queue_depth", "max_batch")),
+        "serve_stop": _act(("terminals", "model_step", "swaps")),
+        "admit": _act(("id", "deadline_ms")),
+        "respond": _act(("id", "model_step", "tier", "batch", "bucket",
+                         "latency_ms")),
+        "reject": _act(("id", "reason", "admitted")),
+        "weight_swap": _act(("step", "from_step", "digest", "tier",
+                             "source_artifact", "source_digest",
+                             "swap_ms"),
+                            ("initial",)),
+        "follow_quant_sidecar_fallback": _act(("step", "tier",
+                                               "reason")),
+        "follow_skip": _act(("step", "error")),
+        "follow_corrupt_checkpoint_fallback": _act(("bad_step",
+                                                    "error")),
+        "follow_fallback_restore": _act(("step",)),
+        "follow_cross_world_restore": _act(("step", "saved_world",
+                                            "new_world")),
+    },
+))
+
+# Trainer metrics series (train/loop.py train_log.jsonl).
+_declare(EventSchema(
+    STEP,
+    required=("step", "time", "loss", "train_acc", "lr",
+              "updates_applied", "num_contributors", "examples_per_sec",
+              "flags"),
+))
+
+# Checkpoint-save marker.  Deliberately ``at_step``, NOT ``step``: the
+# resume watch (launch/cluster.py parse_poll_output) treats any record
+# carrying ``step`` as training progress — a save record naming
+# ``step`` would fake progress on a stalled worker.  This registry
+# entry is what makes that a checked contract instead of lore.
+_declare(EventSchema(
+    SAVE,
+    required=("at_step", "save_stall_ms", "async_snapshot"),
+    optional=("quant_tiers",),
+))
+
+# Compile record: ``compile_s``/``source`` plus whatever the AOT
+# executable cache measured — dynamic by design.
+_declare(EventSchema(
+    COMPILE,
+    optional=("compile_s", "source", "persistent_cache", "error"),
+    open_payload=True,
+))
+
+# Serving liveness counter (servesvc/server.py, the replica's
+# train_log.jsonl — the supervisor's progress probe reads ``step``).
+_declare(EventSchema(HEARTBEAT, required=("step",)))
+
+# Load-generator journal (servesvc/loadgen.py loadgen.jsonl): every
+# issued request and its exactly-one terminal outcome.
+_declare(EventSchema(
+    LOAD,
+    required=("action", "id"),
+    actions={
+        "issue": _act(),
+        "outcome": _act(("status",),
+                        ("reason", "model_step", "tier", "attempts",
+                         "endpoint", "latency_ms")),
+    },
+))
+
+# Fault-injector firings (launch/cluster.py) — the exemption evidence
+# the replay invariants match violations against.
+_declare(EventSchema(
+    FAULT,
+    required=("action", "worker"),
+    actions={
+        "kill_worker": _act(("pid", "at_step", "planned_step")),
+        "hang_worker": _act(("pid", "at_step", "planned_step")),
+        "stall_worker": _act(("pid", "stall_ms", "at_step",
+                              "planned_step")),
+        "corrupt_latest_checkpoint": _act(("at_step", "planned_step"),
+                                          ("target", "truncated_to")),
+    },
+))
+
+# Cluster-backend bookkeeping markers (launch/cluster.py).
+_declare(EventSchema(
+    LIFECYCLE,
+    required=("action",),
+    actions={
+        "stale_state": _act(("cluster", "error")),
+        "delete": _act(("cluster",)),
+        "stale_worker_reaped": _act(("worker", "pid")),
+        "standby_reaped": _act(("standby", "pid")),
+        "promote_standby": _act(("worker", "standby", "pid")),
+        "standby_backfill_failed": _act(("error",)),
+    },
+))
+
+# Process spawns: a worker slot or a warm standby.
+_declare(EventSchema(
+    SPAWN,
+    required=("pid", "command"),
+    optional=("worker", "standby"),
+))
+
+# One record per chaos trial (launch/chaos.py chaos_report.jsonl).
+_declare(EventSchema(
+    CHAOS_TRIAL,
+    required=("trial", "seed", "schedule", "described", "outcome",
+              "step", "target", "duration_s", "verdicts", "violations"),
+    optional=("mttr", "boot_s", "stall_timeout_s", "faults",
+              "reconfigures", "final_world", "serving", "serve_swaps",
+              "shrunk"),
+))
+
+# Continuous evaluator (evalsvc/evaluator.py eval_log.jsonl).
+_declare(EventSchema(
+    EVAL,
+    required=("step", "num_examples", "precision_at_1", "loss",
+              "seconds"),
+))
+
+
+# ---------------------------------------------------------------------------
+# accessors — what journal.py / invariants.py / the AST pass consume
+# ---------------------------------------------------------------------------
+
+def event_kinds() -> tuple[str, ...]:
+    return tuple(sorted(EVENT_SCHEMAS))
+
+
+def schema_for(kind: str) -> EventSchema | None:
+    return EVENT_SCHEMAS.get(kind)
+
+
+def action_schema(kind: str, action: str) -> ActionSchema | None:
+    s = EVENT_SCHEMAS.get(kind)
+    if s is None or s.actions is None:
+        return None
+    return s.actions.get(action)
+
+
+def required_fields(kind: str, action: str | None = None
+                    ) -> tuple[str, ...]:
+    """The fields every record of ``kind`` (and ``action``, when given)
+    is required to carry — payload fields only, envelope excluded.
+    Summarizers project records through this instead of keeping their
+    own lists."""
+    s = EVENT_SCHEMAS.get(kind)
+    if s is None:
+        raise KeyError(f"unknown journal event kind {kind!r}")
+    out = [f for f in s.required if f != "action"]
+    if action is not None:
+        a = action_schema(kind, action)
+        if a is None:
+            raise KeyError(f"unknown action {action!r} for journal "
+                           f"event kind {kind!r}")
+        out += [f for f in a.required if f not in out]
+    return tuple(out)
+
+
+def payload_fields(kind: str, action: str | None = None
+                   ) -> tuple[str, ...]:
+    """Required + optional payload fields, in declaration order."""
+    s = EVENT_SCHEMAS.get(kind)
+    if s is None:
+        raise KeyError(f"unknown journal event kind {kind!r}")
+    out = list(required_fields(kind, action))
+    out += [f for f in s.optional if f not in out]
+    if action is not None:
+        a = action_schema(kind, action)
+        if a is not None:
+            out += [f for f in a.optional if f not in out]
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# runtime validation (the dynamic-payload half of graftcheck)
+# ---------------------------------------------------------------------------
+
+def validate_event(record: Mapping[str, Any],
+                   source: str | None = None) -> list[str]:
+    """Check one about-to-be-written record against the registry.
+
+    Returns a list of problem strings (empty = conforming).  Records
+    without an ``event`` key are not journal events (sweep-result rows
+    share the JSONL sink) and pass vacuously."""
+    kind = record.get("event")
+    if kind is None:
+        return []
+    where = f" ({source})" if source else ""
+    if not isinstance(kind, str) or kind not in EVENT_SCHEMAS:
+        return [f"unknown journal event kind {kind!r}{where} — declare "
+                "it in obsv/schema.py"]
+    s = EVENT_SCHEMAS[kind]
+    problems: list[str] = []
+    keys = set(record) - set(ENVELOPE_FIELDS)
+    allowed = set(s.required) | set(s.optional)
+    for f in s.required:
+        if f not in record:
+            problems.append(f"event {kind!r}{where} missing required "
+                            f"field {f!r}")
+    action = record.get("action")
+    a: ActionSchema | None = None
+    if (s.actions is not None and "action" in record
+            and not isinstance(action, str)):
+        # a non-string action is exactly the dynamically-built-payload
+        # bug this validator exists to catch — never let it pass as
+        # "no action to check"
+        problems.append(f"event {kind!r}{where} has non-string action "
+                        f"{action!r} — actions are declared string "
+                        "names (obsv/schema.py)")
+    if s.actions is not None and isinstance(action, str):
+        a = s.actions.get(action)
+        if a is None:
+            problems.append(f"event {kind!r}{where} has undeclared "
+                            f"action {action!r} — declare it in "
+                            "obsv/schema.py")
+        else:
+            allowed |= set(a.required) | set(a.optional)
+            for f in a.required:
+                if f not in record:
+                    problems.append(
+                        f"event {kind!r} action {action!r}{where} "
+                        f"missing required field {f!r}")
+    # unknown-key check only when the payload is closed AND the allowed
+    # set is fully known (no action axis, or the action resolved)
+    if not s.open_payload and (s.actions is None or a is not None):
+        unknown = sorted(keys - allowed)
+        if unknown:
+            problems.append(
+                f"event {kind!r}"
+                + (f" action {action!r}" if action else "")
+                + f"{where} carries undeclared field(s) "
+                + ", ".join(repr(u) for u in unknown)
+                + " — add them to obsv/schema.py or stop writing them")
+    return problems
+
+
+def check_event(record: Mapping[str, Any],
+                source: str | None = None) -> None:
+    """Raise :class:`EventSchemaError` on a non-conforming record."""
+    problems = validate_event(record, source=source)
+    if problems:
+        raise EventSchemaError("; ".join(problems))
+
+
+def validation_enabled() -> bool:
+    """Debug-mode gate: ``DMT_VALIDATE_EVENTS`` truthy (tests set it;
+    production writers skip the per-record check entirely)."""
+    return os.environ.get("DMT_VALIDATE_EVENTS", "").lower() in (
+        "1", "true", "yes", "on")
+
+
+def maybe_check_event(record: Mapping[str, Any],
+                      source: str | None = None) -> None:
+    """The env-gated hook the shared journal-write helpers call."""
+    if validation_enabled():
+        check_event(record, source=source)

@@ -7,7 +7,17 @@ mesh on one CPU host. Platform setup MUST happen before any test
 import initializes the XLA backend.
 """
 
-from distributedmnist_tpu.core.mesh import simulate_devices
+import os
+
+# Journal-schema enforcement ON for every test run: records the AST
+# pass (distributedmnist_tpu.analysis, "graftcheck") can't see as
+# literal dicts still get checked against obsv/schema.py at write time
+# (core/log.py JsonlSink). Set before anything writes — the sink
+# samples the gate on its FIRST write and freezes it for the process
+# (hot path); per-call toggling only affects schema.maybe_check_event.
+os.environ.setdefault("DMT_VALIDATE_EVENTS", "1")
+
+from distributedmnist_tpu.core.mesh import simulate_devices  # noqa: E402
 
 simulate_devices(8)
 

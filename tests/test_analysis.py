@@ -1,0 +1,524 @@
+"""graftcheck (distributedmnist_tpu.analysis) — the static-analysis
+toolchain's own contract.
+
+Three layers:
+
+* fixture snippets per checker — a known-bad snippet must produce the
+  expected finding, the known-good twin must stay clean;
+* schema-registry round-trips — the ``obsv/schema.py`` registry, the
+  ``obsv/journal.py`` summarizers and the runtime validator must agree
+  on required fields (the drift this PR exists to kill);
+* the self-check — graftcheck over the package + tests must be clean
+  modulo the checked-in baseline, with no stale baseline entries.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from pathlib import Path
+
+import pytest
+
+from distributedmnist_tpu.analysis import (CHECKERS, iter_sources,
+                                           load_baseline, run_checkers)
+from distributedmnist_tpu.analysis.core import Source
+from distributedmnist_tpu.analysis import (config_check, jax_check,
+                                           schema_check, threads_check)
+from distributedmnist_tpu.obsv import schema
+
+REPO = Path(__file__).resolve().parents[1]
+PKG = REPO / "distributedmnist_tpu"
+
+
+def src(path: str, text: str) -> Source:
+    return Source(path=path, tree=ast.parse(text), text=text)
+
+
+def keys(findings) -> set[str]:
+    return {f.key for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# schema checker fixtures
+# ---------------------------------------------------------------------------
+
+class TestSchemaChecker:
+    def check(self, text: str):
+        return schema_check.check(
+            [src("distributedmnist_tpu/launch/snippet.py", text)])
+
+    def test_unknown_kind_flagged(self):
+        got = self.check('sink.write({"event": "telemetry", "x": 1})\n')
+        assert any("unknown-kind.telemetry" in k for k in keys(got))
+
+    def test_missing_required_field_flagged(self):
+        got = self.check(
+            'sink.write({"event": "save", "save_stall_ms": 1.0,\n'
+            '            "async_snapshot": True})\n')
+        assert any("missing.save.at_step" in k for k in keys(got))
+
+    def test_undeclared_field_flagged(self):
+        # the PR-12 lesson as a fixture: a save record writing "step"
+        # would fake training progress to the resume watch
+        got = self.check(
+            'sink.write({"event": "save", "at_step": 3, "step": 3,\n'
+            '            "save_stall_ms": 1.0, "async_snapshot": True})\n')
+        assert any("undeclared.save.step" in k for k in keys(got))
+
+    def test_undeclared_action_flagged(self):
+        got = self.check('j({"event": "recovery", "action": "resurrect",'
+                         ' "worker": 1})\n')
+        assert any("unknown-action.recovery.resurrect" in k
+                   for k in keys(got))
+
+    def test_conforming_record_clean(self):
+        got = self.check(
+            'sink.write({"event": "save", "at_step": 3, "time": 1.0,\n'
+            '            "save_stall_ms": 1.0, "async_snapshot": True})\n')
+        assert got == []
+
+    def test_dynamic_payload_checks_literal_keys_only(self):
+        # **extra hides fields from the AST: no missing-required
+        # finding, but a literally-written unknown key still fires
+        got = self.check('sink.write({"event": "save", "bogus": 1,'
+                         ' **extra})\n')
+        ks = keys(got)
+        assert any("undeclared.save.bogus" in k for k in ks)
+        assert not any("missing" in k for k in ks)
+
+    def test_wrapper_kwargs_checked(self):
+        # supervisor-style wrapper: action arg0, payload kwargs
+        text = 'self._event("detect", worker=1, kindz="dead")\n'
+        got = schema_check.check(
+            [src("distributedmnist_tpu/launch/supervisor.py", text)])
+        ks = keys(got)
+        assert any("undeclared.recovery.detect.kindz" in k for k in ks)
+        assert any("missing.recovery.detect.kind" in k for k in ks)
+
+    def test_tests_are_exempt(self):
+        got = schema_check.check(
+            [src("tests/test_x.py",
+                 'w({"event": "telemetry", "x": 1})\n')])
+        assert got == []
+
+
+# ---------------------------------------------------------------------------
+# config checker fixtures
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def config_source():
+    text = (PKG / "core" / "config.py").read_text()
+    return src("distributedmnist_tpu/core/config.py", text)
+
+
+class TestConfigChecker:
+    def test_unknown_knob_flagged(self, config_source):
+        bad = src("distributedmnist_tpu/train/snippet.py",
+                  "def f(cfg):\n    return cfg.train.max_stepz\n")
+        got = config_check.check([config_source, bad])
+        assert any("unknown.train.max_stepz" in k for k in keys(got))
+
+    def test_declared_knob_and_method_clean(self, config_source):
+        good = src("distributedmnist_tpu/train/snippet.py",
+                   "def f(cfg):\n"
+                   "    a = cfg.train.max_steps\n"
+                   "    b = cfg.quant.resolved_publish_tiers()\n"
+                   "    c = cfg.data.effective_device_prefetch_depth()\n")
+        got = config_check.check([config_source, good])
+        assert not any(k.startswith("config:distributedmnist_tpu/train/")
+                       for k in keys(got))
+
+    def test_dead_knob_flagged_and_read_clears_it(self, config_source):
+        reader = src("distributedmnist_tpu/train/snippet.py",
+                     "def f(cfg):\n    return cfg.train.max_steps\n")
+        got = keys(config_check.check([config_source, reader]))
+        assert any("dead.train.seed" in k for k in got)  # nothing reads it here
+        assert not any("dead.train.max_steps" in k for k in got)
+
+    def test_real_tree_has_no_dead_knobs(self):
+        srcs = iter_sources([PKG, REPO / "tests"], repo_root=REPO)
+        got = keys(config_check.check(srcs))
+        dead = sorted(k for k in got if ":dead." in k)
+        assert dead == [], f"declared-but-unread knobs: {dead}"
+
+
+# ---------------------------------------------------------------------------
+# concurrency checker fixtures
+# ---------------------------------------------------------------------------
+
+_RACY = """
+import threading
+
+class Racy:
+    def __init__(self):
+        self.counter = 0
+        self._lock = threading.Lock()
+        self.t = threading.Thread(target=self._work)
+
+    def _work(self):
+        while True:
+            self.counter += 1
+
+    def bump(self):
+        self.counter += 1
+"""
+
+_LOCKED = _RACY.replace(
+    "    def bump(self):\n        self.counter += 1\n",
+    "    def bump(self):\n        with self._lock:\n"
+    "            self.counter += 1\n").replace(
+    "        while True:\n            self.counter += 1\n",
+    "        while True:\n            with self._lock:\n"
+    "                self.counter += 1\n")
+
+
+class TestThreadsChecker:
+    def check(self, text):
+        return threads_check.check(
+            [src("distributedmnist_tpu/servesvc/snippet.py", text)])
+
+    def test_cross_root_unguarded_write_flagged(self):
+        got = self.check(_RACY)
+        assert any(k.endswith("Racy.counter") for k in keys(got))
+
+    def test_lock_guarded_writes_clean(self):
+        assert self.check(_LOCKED) == []
+
+    def test_init_writes_exempt(self):
+        # construction happens-before thread start: a class whose only
+        # shared-attr writes are in __init__ is clean
+        text = _RACY.replace(
+            "    def bump(self):\n        self.counter += 1\n", "")
+        text = text.replace(
+            "        while True:\n            self.counter += 1\n",
+            "        while True:\n            pass\n")
+        assert self.check(text) == []
+
+    def test_timer_function_and_positional_target_resolved(self):
+        # Timer's callable is arg 1 (or function=); Thread's is arg 1
+        # (or target=) — arg0 is interval/group, never the callable
+        for spawn in ("threading.Timer(0.5, self._work).start()",
+                      "threading.Timer(0.5, function=self._work)"
+                      ".start()",
+                      "threading.Thread(None, self._work).start()"):
+            text = f"""
+import threading
+
+class Racy:
+    def __init__(self):
+        self.counter = 0
+
+    def start(self):
+        {spawn}
+
+    def _work(self):
+        self.counter += 1
+
+    def bump(self):
+        self.counter += 1
+"""
+            got = self.check(text)
+            assert any(k.endswith("Racy.counter") for k in keys(got)), \
+                spawn
+
+    def test_thread_target_via_loop_tuple_resolved(self):
+        text = """
+import threading
+
+class Looper:
+    def __init__(self):
+        self.state = 0
+
+    def start(self):
+        for target in (self._a, self._b):
+            threading.Thread(target=target).start()
+
+    def _a(self):
+        self.state = 1
+
+    def _b(self):
+        self.state = 2
+"""
+        got = self.check(text)
+        assert any(k.endswith("Looper.state") for k in keys(got))
+
+
+# ---------------------------------------------------------------------------
+# jax checker fixtures
+# ---------------------------------------------------------------------------
+
+class TestJaxChecker:
+    def check(self, text):
+        return jax_check.check(
+            [src("distributedmnist_tpu/parallel/snippet.py", text)])
+
+    def test_use_after_donate_flagged(self):
+        got = self.check(
+            "from jax import jit\n"
+            "f = jit(lambda s: s, donate_argnums=0)\n"
+            "def g(state):\n"
+            "    out = f(state)\n"
+            "    return state\n")
+        assert any("donate.g.state" in k for k in keys(got))
+
+    def test_rebind_is_clean(self):
+        got = self.check(
+            "from jax import jit\n"
+            "f = jit(lambda s: s, donate_argnums=0)\n"
+            "def g(state):\n"
+            "    state = f(state)\n"
+            "    return state\n")
+        assert got == []
+
+    def test_loop_donation_without_rebind_flagged(self):
+        got = self.check(
+            "from jax import jit\n"
+            "f = jit(lambda s, b: s, donate_argnums=0)\n"
+            "def train_loop(state, batches):\n"
+            "    for b in batches:\n"
+            "        out = f(state, b)\n")
+        assert any("donate-loop.train_loop.state" in k for k in keys(got))
+
+    def test_loop_donation_with_rebind_clean(self):
+        got = self.check(
+            "from jax import jit\n"
+            "f = jit(lambda s, b: s, donate_argnums=0)\n"
+            "def train_loop(state, batches):\n"
+            "    for b in batches:\n"
+            "        state = f(state, b)\n")
+        assert got == []
+
+    def test_branch_return_does_not_poison_sibling(self):
+        # the parallel/api.py fast-path shape: two alternative returns
+        # must not read as use-after-donate
+        got = self.check(
+            "from jax import jit\n"
+            "f = jit(lambda s: s, donate_argnums=0)\n"
+            "def g(state, exe):\n"
+            "    if exe is not None:\n"
+            "        return exe(state)\n"
+            "    return f(state)\n")
+        assert got == []
+
+    def test_item_in_hot_loop_flagged(self):
+        got = self.check(
+            "from jax import jit\n"
+            "f = jit(lambda x: x)\n"
+            "def run_loop(xs):\n"
+            "    for x in xs:\n"
+            "        y = f(x)\n"
+            "        print(y.item())\n")
+        assert any("host-sync.run_loop.item" in k for k in keys(got))
+
+    def test_float_over_jitted_result_in_loop_flagged(self):
+        got = self.check(
+            "from jax import jit\n"
+            "f = jit(lambda x: x)\n"
+            "def step_loop(xs):\n"
+            "    t = 0.0\n"
+            "    for x in xs:\n"
+            "        y = f(x)\n"
+            "        t += float(y)\n")
+        assert any("host-sync.step_loop.float" in k for k in keys(got))
+
+    def test_scalar_loop_var_into_jit_flagged(self):
+        got = self.check(
+            "from jax import jit\n"
+            "f = jit(lambda i, x: x)\n"
+            "def run(x):\n"
+            "    for i in range(10):\n"
+            "        f(i, x)\n")
+        assert any("scalar-jit.run.i" in k for k in keys(got))
+
+    def test_static_argnums_silences_scalar_signature(self):
+        got = self.check(
+            "from jax import jit\n"
+            "f = jit(lambda i, x: x, static_argnums=0)\n"
+            "def run(x):\n"
+            "    for i in range(10):\n"
+            "        f(i, x)\n")
+        assert got == []
+
+    def test_donation_respects_argnums_positions(self):
+        # donate_argnums=(0,): reading the NON-donated batch after the
+        # call is fine; reading the donated state is not
+        got = self.check(
+            "from jax import jit\n"
+            "f = jit(lambda s, b: s, donate_argnums=(0,))\n"
+            "def g(state, batch):\n"
+            "    out = f(state, batch)\n"
+            "    print(batch)\n"
+            "    return out\n")
+        assert got == []
+        got = self.check(
+            "from jax import jit\n"
+            "f = jit(lambda s, b: s, donate_argnums=(0,))\n"
+            "def g(state, batch):\n"
+            "    out = f(state, batch)\n"
+            "    print(state)\n")
+        assert any("donate.g.state" in k for k in keys(got))
+
+    def test_device_iteration_not_scalar_hazard(self):
+        # iterating device arrays (timing.py's token warmup) is not the
+        # python-scalar recompile hazard
+        got = self.check(
+            "from jax import jit\n"
+            "f = jit(lambda x: x)\n"
+            "def run(tokens):\n"
+            "    for t in tokens:\n"
+            "        f(t)\n")
+        assert got == []
+
+
+# ---------------------------------------------------------------------------
+# registry round-trips: emitters, summarizers and the validator agree
+# ---------------------------------------------------------------------------
+
+class TestSchemaRegistry:
+    def test_reconfigure_summary_projects_registry_fields(self):
+        from distributedmnist_tpu.obsv.journal import (
+            summarize_reconfigure_events)
+        begin = {"event": "reconfigure", "action": "begin",
+                 "old_world": 3, "new_world": 2,
+                 "trigger": "below_quorum", "quorum": 3,
+                 "effective_quorum": 2, "survivors": [0, 1]}
+        got = summarize_reconfigure_events([begin])
+        assert set(got["transitions"][0]) == set(
+            schema.required_fields(schema.RECONFIGURE, "begin"))
+
+    def test_quorum_transition_summary_projects_registry_fields(self):
+        from distributedmnist_tpu.obsv.journal import (
+            summarize_recovery_events)
+        rec = {"event": "recovery", "action": "quorum_transition",
+               "workers_alive": 2, "num_workers": 3, "quorum": 2,
+               "degraded": True}
+        got = summarize_recovery_events([rec])
+        assert set(got["quorum_transitions"][0]) == set(
+            schema.required_fields(schema.RECOVERY, "quorum_transition"))
+
+    def test_summarizer_read_fields_are_declared(self):
+        # every field summarize_mttr projects off a resume record must
+        # be a declared resume field — reader/emitter agreement
+        fields = schema.payload_fields(schema.RECOVERY, "resume")
+        for f in ("mttr_s", "resume_after_respawn_s", "step"):
+            assert f in fields
+
+    def test_every_required_field_validates(self):
+        for kind, sch in schema.EVENT_SCHEMAS.items():
+            rec = {"event": kind}
+            for f in sch.required:
+                rec[f] = 0
+            if sch.actions:
+                for action, act in sch.actions.items():
+                    r = dict(rec, action=action,
+                             **{f: 0 for f in act.required})
+                    assert schema.validate_event(r) == [], (kind, action)
+            else:
+                assert schema.validate_event(rec) == [], kind
+
+    def test_validator_catches_drift(self):
+        assert schema.validate_event({"event": "nope"})
+        assert schema.validate_event({"event": "save"})  # missing fields
+        assert schema.validate_event(
+            {"event": "save", "at_step": 1, "save_stall_ms": 0.0,
+             "async_snapshot": True, "step": 1})  # undeclared field
+        assert schema.validate_event(
+            {"event": "recovery", "action": "resurrect"})
+        # non-journal rows (no "event") pass vacuously
+        assert schema.validate_event({"name": "sweep", "acc": 0.9}) == []
+
+    def test_non_string_action_is_a_problem(self):
+        # a dynamically-built payload that sets action=None must be
+        # flagged, not skipped as "no action to check"
+        assert schema.validate_event(
+            {"event": "serve", "action": None, "garbage": 1})
+
+    def test_check_event_raises(self):
+        with pytest.raises(schema.EventSchemaError):
+            schema.check_event({"event": "telemetry"})
+
+    def test_env_gate(self, monkeypatch):
+        monkeypatch.setenv("DMT_VALIDATE_EVENTS", "0")
+        schema.maybe_check_event({"event": "telemetry"})  # gated off
+        monkeypatch.setenv("DMT_VALIDATE_EVENTS", "1")
+        with pytest.raises(schema.EventSchemaError):
+            schema.maybe_check_event({"event": "telemetry"})
+
+    def test_jsonl_sink_enforces_in_tests(self, tmp_path):
+        # conftest turns DMT_VALIDATE_EVENTS on for the whole suite:
+        # the shared sink must refuse a nonconforming record
+        from distributedmnist_tpu.core.log import JsonlSink
+        with JsonlSink(tmp_path / "j.jsonl") as sink:
+            sink.write({"event": "heartbeat", "step": 1})  # conforming
+            sink.write({"rows": 3})                        # non-event
+            with pytest.raises(schema.EventSchemaError):
+                sink.write({"event": "heartbeat"})  # missing step
+
+
+# ---------------------------------------------------------------------------
+# the self-check: graftcheck over this very tree
+# ---------------------------------------------------------------------------
+
+class TestSelfCheck:
+    def test_package_clean_modulo_baseline(self):
+        sources = iter_sources([PKG, REPO / "tests"], repo_root=REPO)
+        findings = run_checkers(sources)
+        baseline = load_baseline()
+        new = [f for f in findings if f.key not in baseline]
+        assert new == [], (
+            "graftcheck found non-baselined findings:\n"
+            + "\n".join(f"{f.path}:{f.line}: {f.message}" for f in new))
+        fired = {f.key for f in findings}
+        stale = sorted(set(baseline) - fired)
+        assert stale == [], f"stale baseline entries: {stale}"
+
+    def test_unparseable_file_is_a_finding(self, tmp_path):
+        bad = tmp_path / "broken.py"
+        bad.write_text("def broken(:\n")
+        sources = iter_sources([bad], repo_root=tmp_path)
+        findings = run_checkers(sources)
+        assert any(f.checker == "parse"
+                   and "syntax-error" in f.key for f in findings)
+
+    def test_targeted_run_does_not_report_untested_baseline_stale(self):
+        # a subset invocation (roots that exclude servesvc) must not
+        # read the ServingReplica suppressions as stale — exit 0
+        import subprocess, sys
+        p = subprocess.run(
+            [sys.executable, "-m", "distributedmnist_tpu.analysis",
+             "distributedmnist_tpu/train"],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+        assert "STALE" not in p.stdout
+
+    def test_unknown_checker_is_a_usage_error(self):
+        import subprocess, sys
+        p = subprocess.run(
+            [sys.executable, "-m", "distributedmnist_tpu.analysis",
+             "--checkers", "cofnig"],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert p.returncode != 0
+        assert "unknown checker" in p.stderr
+
+    def test_all_checkers_registered(self):
+        run_checkers([])  # force registration imports
+        assert set(CHECKERS) == {"schema", "config", "threads", "jax"}
+
+    def test_baseline_entries_carry_justifications(self):
+        raw = json.loads(
+            (PKG / "analysis" / "baseline.json").read_text())
+        for entry in raw["accepted"]:
+            assert entry.get("justification", "").strip(), entry["key"]
+
+    def test_cli_json_exits_zero(self):
+        import subprocess, sys
+        p = subprocess.run(
+            [sys.executable, "-m", "distributedmnist_tpu.analysis",
+             "--format", "json"],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+        report = json.loads(p.stdout)
+        assert report["ok"] is True
+        assert report["files_analyzed"] > 50

@@ -317,17 +317,25 @@ def publish_quant_step(staging: Path, serve_dir: Path, step: int,
                        tear_sidecar: bool = False) -> None:
     """publish_step plus the quant sidecar family; ``tear_sidecar``
     truncates the sidecar AFTER the copy (its digest stays intact) —
-    the torn-sidecar scenario digest verification must refuse."""
+    the torn-sidecar scenario digest verification must refuse.
+
+    The sidecar lands BEFORE the pointer flip (publish_step): a
+    fast-polling follower that reads the pointer the instant it moves
+    must find the sidecar already there, or this test races — a
+    replica that consumes the step through the absent-sidecar fallback
+    never re-reads it (by design; journaled), so the expected tier
+    would be timing-dependent."""
+    if with_sidecar:
+        qname = f"ckpt-{step:08d}.quant.msgpack"
+        serve_dir.mkdir(parents=True, exist_ok=True)
+        shutil.copy2(staging / qname, serve_dir / qname)
+        shutil.copy2(staging / (qname + ".sha256"),
+                     serve_dir / (qname + ".sha256"))
+        if tear_sidecar:
+            data = (serve_dir / qname).read_bytes()
+            (serve_dir / qname).write_bytes(
+                data[:max(1, len(data) // 2)])
     publish_step(staging, serve_dir, step)
-    if not with_sidecar:
-        return
-    qname = f"ckpt-{step:08d}.quant.msgpack"
-    shutil.copy2(staging / qname, serve_dir / qname)
-    shutil.copy2(staging / (qname + ".sha256"),
-                 serve_dir / (qname + ".sha256"))
-    if tear_sidecar:
-        data = (serve_dir / qname).read_bytes()
-        (serve_dir / qname).write_bytes(data[:max(1, len(data) // 2)])
 
 
 def test_int8_tier_preferred_and_meta_reports_it(quant_published,
