@@ -558,6 +558,98 @@ class ServeConfig:
 SERVING_PRECISION_TIERS = ("fp32", "bf16", "int8")
 QUANT_TIERS = ("bf16", "int8")
 
+# Mid-generation weight-swap disciplines for the decode service.
+DECODE_SWAP_POLICIES = ("pin", "restart")
+
+
+@dataclass(frozen=True)
+class DecodeConfig:
+    """Continuous-batching autoregressive decode (``servesvc/decode.py``)
+    — the generation face of the serving tier. A decode replica holds
+    ``decode_slots`` concurrently-generating sequences over ONE paged
+    KV cache, so sequences of wildly different lengths share a single
+    compiled decode shape; a slot is refilled the step its sequence
+    finishes (EOS / max_tokens / deadline), never held for a padded
+    round.
+
+    * ``block_size`` / ``num_blocks`` — the paged cache geometry: K/V
+      live in fixed-size blocks handed out by a free-list allocator
+      (block 0 is the reserved null block idle slots write into), and
+      each sequence owns a block table mapping its positions to
+      blocks. Admission reserves every block a sequence can need
+      (prompt + ``max_new_tokens``), so an admitted sequence can
+      always run to completion — block pressure defers admission, it
+      never kills a running generation.
+    * ``max_prompt_len`` — prompts pad to power-of-2 buckets up to
+      this (each bucket's prefill compiles once); longer prompts are a
+      typed ``bad_request``.
+    * ``max_new_tokens`` — the per-request generation ceiling (a
+      request may ask for fewer, never more).
+    * ``eos_token`` — generation stops when this token is sampled;
+      -1 disables (sequences run to max_tokens).
+    * ``temperature`` / ``top_k`` — default sampling knobs
+      (``models.registry.sample_token``; temperature <= 0 = greedy
+      argmax, deterministic). Requests may override per-request.
+    * ``swap_policy`` — what a weight hot-swap does to sequences
+      mid-generation: ``"pin"`` keeps each in-flight sequence on the
+      params it started with until it finishes (new admissions use
+      the new weights; at most a handful of param versions are live
+      at once), ``"restart"`` re-prefills every in-flight sequence on
+      the new weights (journaled per sequence as ``seq_restart`` —
+      the causal license the ``decode_swap`` replay invariant
+      requires whenever a sequence finishes on a different step than
+      it started on).
+    """
+
+    decode_slots: int = 4
+    block_size: int = 16
+    num_blocks: int = 128
+    max_prompt_len: int = 64
+    max_new_tokens: int = 32
+    eos_token: int = -1
+    temperature: float = 0.0
+    top_k: int = 0
+    swap_policy: str = "pin"
+
+    def validate(self) -> None:
+        """Build-time validation (DecodeReplica construction): a bad
+        knob is a typed ConfigError naming the constraint, not a shape
+        error mid-generation."""
+        if self.swap_policy not in DECODE_SWAP_POLICIES:
+            raise ConfigError(
+                f"decode.swap_policy={self.swap_policy!r} is not a "
+                f"known policy; valid policies: "
+                f"{', '.join(DECODE_SWAP_POLICIES)}")
+        if self.decode_slots < 1:
+            raise ConfigError(
+                f"decode.decode_slots must be >= 1, got "
+                f"{self.decode_slots}")
+        if self.block_size < 1 or self.num_blocks < 2:
+            raise ConfigError(
+                f"decode.block_size must be >= 1 and decode.num_blocks "
+                f">= 2 (block 0 is the reserved null block), got "
+                f"block_size={self.block_size} "
+                f"num_blocks={self.num_blocks}")
+        if self.max_prompt_len < 1 or self.max_new_tokens < 1:
+            raise ConfigError(
+                "decode.max_prompt_len and decode.max_new_tokens must "
+                f"be >= 1, got {self.max_prompt_len}/"
+                f"{self.max_new_tokens}")
+        need = self.max_blocks_per_seq()
+        if self.num_blocks - 1 < need:
+            raise ConfigError(
+                f"decode.num_blocks={self.num_blocks} cannot hold even "
+                f"one sequence: max_prompt_len + max_new_tokens = "
+                f"{self.max_prompt_len + self.max_new_tokens} tokens "
+                f"need {need} blocks of {self.block_size} (+1 reserved "
+                "null block)")
+
+    def max_blocks_per_seq(self) -> int:
+        """Blocks one sequence can ever need (prompt + generation) —
+        the fixed block-table width every compiled decode shape uses."""
+        total = self.max_prompt_len + self.max_new_tokens
+        return -(-total // self.block_size)
+
 
 @dataclass(frozen=True)
 class QuantConfig:
@@ -680,6 +772,7 @@ class ExperimentConfig:
     train: TrainConfig = field(default_factory=TrainConfig)
     eval: EvalConfig = field(default_factory=EvalConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    decode: DecodeConfig = field(default_factory=DecodeConfig)
     quant: QuantConfig = field(default_factory=QuantConfig)
 
     # ---- construction helpers -------------------------------------------------
@@ -757,6 +850,7 @@ _SECTION_TYPES = {
     ("ExperimentConfig", "train"): TrainConfig,
     ("ExperimentConfig", "eval"): EvalConfig,
     ("ExperimentConfig", "serve"): ServeConfig,
+    ("ExperimentConfig", "decode"): DecodeConfig,
     ("ExperimentConfig", "quant"): QuantConfig,
 }
 

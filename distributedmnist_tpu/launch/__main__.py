@@ -115,7 +115,10 @@ def _serve(args) -> None:
     publish dir's checkpoints and serve inference over a local socket
     — the process the cluster's serving payload verb spawns. Runs on
     ONE ambient device (no simulated mesh, no collectives), adopting
-    the model/config from the checkpoint itself like the evaluator."""
+    the model/config from the checkpoint itself like the evaluator.
+    ``--decode`` swaps the workload inside the replica contract from
+    one-shot classification to continuous-batching autoregressive
+    decode (streaming tokens, paged KV cache)."""
     import dataclasses
 
     from ..servesvc.server import ServingReplica, wait_for_run_config
@@ -127,6 +130,16 @@ def _serve(args) -> None:
                   "precision_tier", "compute_dtype")
                  if getattr(args, k) is not None}
     scfg = dataclasses.replace(cfg.serve, **overrides)
+    if args.decode:
+        from ..servesvc.decode import DecodeReplica
+        d_over = {k: getattr(args, k) for k in
+                  ("decode_slots", "max_new_tokens", "max_prompt_len",
+                   "swap_policy")
+                  if getattr(args, k) is not None}
+        dcfg = dataclasses.replace(cfg.decode, **d_over)
+        DecodeReplica(args.train_dir, serve_dir=args.serve_dir,
+                      scfg=scfg, dcfg=dcfg, cfg=cfg).serve_forever()
+        return
     ServingReplica(args.train_dir, serve_dir=args.serve_dir,
                    scfg=scfg, cfg=cfg).serve_forever()
 
@@ -481,6 +494,28 @@ def main(argv=None) -> None:
     pv.add_argument("--compute-dtype", default=None, dest="compute_dtype",
                     help="serving-side activations/matmul dtype "
                          "override (serve.compute_dtype)")
+    pv.add_argument("--decode", action="store_true",
+                    help="serve continuous-batching autoregressive "
+                         "decode (streaming tokens over a paged KV "
+                         "cache) instead of one-shot classification; "
+                         "the followed checkpoint must be a dense-FFN "
+                         "causal LM")
+    pv.add_argument("--decode-slots", type=int, default=None,
+                    dest="decode_slots",
+                    help="concurrently-generating sequences per "
+                         "replica (decode.decode_slots)")
+    pv.add_argument("--max-new-tokens", type=int, default=None,
+                    dest="max_new_tokens",
+                    help="per-request generation ceiling "
+                         "(decode.max_new_tokens)")
+    pv.add_argument("--max-prompt-len", type=int, default=None,
+                    dest="max_prompt_len",
+                    help="longest admissible prompt "
+                         "(decode.max_prompt_len)")
+    pv.add_argument("--swap-policy", default=None, dest="swap_policy",
+                    help="pin | restart — what a weight hot-swap does "
+                         "to sequences mid-generation "
+                         "(decode.swap_policy)")
     pv.set_defaults(fn=_serve)
 
     pl = sub.add_parser(

@@ -115,6 +115,23 @@ _SERVE_PAYLOAD = (
     "--train_dir ../worker0 --serve-dir . --port 0 "
     "--poll-secs 0.2 --queue-depth {queue} --max-batch 8")
 
+# Decode-mode publisher (serve_decode=true): the published model must
+# be a dense-FFN causal LM for the replicas' incremental decode
+# export — a compact transformer on the synthetic LM stream, float32
+# and dense attention for CPU-affordable chaos trials, paced exactly
+# like the classification publisher.
+_DECODE_PUBLISHER_PAYLOAD = (
+    "python -m distributedmnist_tpu.launch train "
+    "train.train_dir=. data.dataset=synthetic_lm data.batch_size=32 "
+    "data.synthetic_train_size=256 data.synthetic_test_size=64 "
+    "data.use_native_pipeline=false "
+    "model.name=transformer model.seq_len=64 model.model_dim=64 "
+    "model.num_heads=4 model.num_layers=2 model.vocab_size=32 "
+    "model.compute_dtype=float32 model.attention_impl=dense "
+    "train.max_steps={max_steps} train.step_pace_ms={pace} "
+    "train.log_every_steps=1 train.save_interval_steps={save} "
+    "train.async_checkpoint=false train.save_results_period=0")
+
 
 @dataclasses.dataclass(frozen=True)
 class ChaosFault:
@@ -420,6 +437,22 @@ class ChaosConfig:
     # exercises the sidecar's digest refusal on a live replica.
     # None/() = every replica full precision (historical behavior).
     serve_precision_tiers: tuple[str, ...] | None = None
+    # Decode-mode serving trials: the publisher trains a compact
+    # causal LM and the replicas run `launch serve --decode` —
+    # continuous-batching streaming generation over the paged KV
+    # cache, with the loadgen driving token prompts through the
+    # generate path. Kill/hang/stall triggers stay in heartbeat units
+    # (finished generations); the decode_swap invariant replays
+    # alongside 7-9. Incompatible with non-fp32 precision tiers (the
+    # decode graph serves full precision only).
+    serve_decode: bool = False
+    # --decode knobs threaded to every decode replica (kept small so
+    # generations finish fast enough for chaos's heartbeat triggers,
+    # and so prompt+generation fit the compact LM's seq_len=64
+    # position table)
+    decode_max_new_tokens: int = 16
+    decode_max_prompt_len: int = 16
+    decode_slots: int = 4
     # schedule intensity
     max_faults: int = 3
     min_faults: int = 1
@@ -475,6 +508,14 @@ class ChaosConfig:
                     f"serve_precision_tiers names unknown tier {t!r}; "
                     f"valid tiers: "
                     f"{', '.join(SERVING_PRECISION_TIERS)}")
+        if self.serve_decode and any(
+                t and t != "fp32"
+                for t in (self.serve_precision_tiers or ())):
+            raise ClusterError(
+                "serve_decode=true is incompatible with non-fp32 "
+                "serve_precision_tiers: the decode service serves "
+                "full precision only (quant sidecars hold weights for "
+                "the one-shot predict export)")
 
     @classmethod
     def from_file(cls, path: str | Path) -> "ChaosConfig":
@@ -559,6 +600,12 @@ class ChaosConfig:
             if measured_boot_s is not None and measured_boot_s > 0:
                 floor = 2500.0 * measured_boot_s / max(1, self.until_step)
                 pace = min(2000.0, max(pace, floor))
+            if self.serve_decode:
+                # decode trials publish a causal LM — no quant
+                # sidecars (validated fp32-only above)
+                return _DECODE_PUBLISHER_PAYLOAD.format(
+                    max_steps=self.until_step, pace=round(pace, 1),
+                    save=self.save_interval_steps)
             cmd = _SERVE_PUBLISHER_PAYLOAD.format(
                 max_steps=self.until_step, pace=round(pace, 1),
                 save=self.save_interval_steps)
@@ -594,6 +641,10 @@ class ChaosConfig:
         out: dict[str, str] = {}
         for k in range(1, self.trial_num_workers()):
             cmd = _SERVE_PAYLOAD.format(queue=self.serve_queue_depth)
+            if self.serve_decode:
+                cmd += (f" --decode --decode-slots {self.decode_slots}"
+                        f" --max-new-tokens {self.decode_max_new_tokens}"
+                        f" --max-prompt-len {self.decode_max_prompt_len}")
             tier = tiers[k - 1] if k - 1 < len(tiers) else ""
             if tier and tier != "fp32":
                 cmd += f" --precision-tier {tier}"
@@ -759,7 +810,8 @@ class ChaosCampaign:
         import threading
 
         from ..servesvc.client import ServeClient, discover_endpoints
-        from ..servesvc.loadgen import make_input_fn, run_load
+        from ..servesvc.loadgen import (make_input_fn, make_prompt_fn,
+                                        run_load)
         cfg = self.cfg
         root = lcfg.root
         stop = threading.Event()
@@ -776,10 +828,20 @@ class ChaosCampaign:
             if meta is None:
                 load_result["summary"] = None  # nothing ever came up
                 return
+            if meta.get("decode"):
+                # decode replicas: drive token prompts through the
+                # streaming generate path (ttft/itl recorded per
+                # request, tokens bounded so generations finish
+                # inside heartbeat-trigger cadence)
+                make_input = make_prompt_fn(meta["vocab_size"],
+                                            meta["max_prompt_len"])
+            else:
+                make_input = make_input_fn(meta["input_shape"],
+                                           meta["input_dtype"])
             load_result["summary"] = run_load(
-                client, None, cfg.load_concurrency,
-                make_input_fn(meta["input_shape"], meta["input_dtype"]),
-                journal_path=root / "loadgen.jsonl", stop_event=stop)
+                client, None, cfg.load_concurrency, make_input,
+                journal_path=root / "loadgen.jsonl", stop_event=stop,
+                decode=bool(meta.get("decode")))
 
         t = threading.Thread(target=drive, daemon=True, name="chaos-load")
         t.start()

@@ -47,11 +47,42 @@ def classification_predictions(logits: jax.Array) -> jax.Array:
     return jax.nn.softmax(logits, axis=-1)
 
 
+def lm_last_logits(logits: jax.Array) -> jax.Array:
+    """Last-position logits [batch, vocab] of a [batch, seq, vocab]
+    causal-LM forward — the shared next-token head every LM consumer
+    (the one-shot ``lm_predictions`` export, the decode service's
+    prefill) reads instead of each re-spelling the slice."""
+    return logits[:, -1]
+
+
 def lm_predictions(logits: jax.Array) -> jax.Array:
     """Next-token distribution [batch, vocab] for a causal LM: softmax
-    over the LAST position's logits — the decode-step export (what an
-    online serving tier samples/ranks from)."""
-    return jax.nn.softmax(logits[:, -1], axis=-1)
+    over the last position's logits (:func:`lm_last_logits`) — the
+    one-shot inference export (what the classification-shaped serving
+    path ranks from)."""
+    return jax.nn.softmax(lm_last_logits(logits), axis=-1)
+
+
+def sample_token(logits: jax.Array, key: jax.Array | None = None,
+                 temperature: float = 0.0,
+                 top_k: int = 0) -> jax.Array:
+    """Sample next-token ids [...] from logits [..., vocab].
+
+    ``temperature <= 0`` is greedy argmax — deterministic, no key
+    needed (and the limit temperature → 0 of the sampled path, pinned
+    in tests). ``temperature > 0`` divides the logits before a
+    categorical draw; ``top_k > 0`` additionally masks everything
+    below the k-th highest logit (top_k=1 ≡ greedy)."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if key is None:
+        raise ValueError("sample_token with temperature > 0 needs a "
+                         "PRNG key")
+    scaled = logits.astype(jnp.float32) / temperature
+    if top_k and top_k > 0:
+        kth = jnp.sort(scaled, axis=-1)[..., -top_k][..., None]
+        scaled = jnp.where(scaled < kth, -1e30, scaled)
+    return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
 
 def lm_eval_metrics(logits: jax.Array, labels: jax.Array,
@@ -135,6 +166,21 @@ class Model:
     # tp_param_specs/pp_param_specs builders above remain the models'
     # hand-built originals and the parity oracle for the tables.
     partition_rules: Callable[..., list] | None = None
+    # Autoregressive-decode exports (causal LMs with dense FFNs only;
+    # None elsewhere — the decode service refuses models without them):
+    # decode_prefill(params, tokens [b, s]) -> (logits [b, s, vocab],
+    # k [L, b, s, h, hd], v [L, b, s, h, hd]) — the prompt forward
+    # through the configured attention kernel that also exports every
+    # layer's K/V for seeding a paged cache; decode_step(params,
+    # tokens [S], positions [S], k_cache, v_cache [L, N, B, h, hd],
+    # block_tables [S, P], lengths [S], block_size=B) -> (logits
+    # [S, vocab], k_cache, v_cache) — one incremental token over the
+    # paged cache, a single compiled shape for any mix of sequence
+    # lengths. decode_cache_shape = (num_layers, num_heads, head_dim),
+    # the geometry the cache is allocated with.
+    decode_prefill: Callable[..., tuple] | None = None
+    decode_step: Callable[..., tuple] | None = None
+    decode_cache_shape: tuple[int, int, int] | None = None
     # Auxiliary loss (MoE load balancing): when True, ``apply`` and the
     # sharded applies accept ``return_aux=True`` and return
     # (logits, aux); the train step adds ``aux_weight * aux``.
@@ -486,11 +532,35 @@ def _transformer(cfg: ModelConfig) -> Model:
                 compute_dtype=compute_dtype)
         return apply_1f1b
 
+    # Decode exports: dense-FFN causal LMs only (MoE routing is
+    # batch-statistics-shaped; an incremental one-token step has no
+    # well-defined group routing to run)
+    decode_prefill = decode_step_fn = decode_cache_shape = None
+    if not moe:
+        def decode_prefill(params, tokens, positions=None):
+            return transformer.prefill_with_kv(
+                params, tokens, num_heads=cfg.num_heads,
+                attention_fn=attention_fn, positions=positions,
+                compute_dtype=compute_dtype)
+
+        def decode_step_fn(params, tokens, positions, k_cache, v_cache,
+                           block_tables, lengths, *, block_size):
+            return transformer.decode_step(
+                params, tokens, positions, k_cache, v_cache,
+                block_tables, lengths, num_heads=cfg.num_heads,
+                block_size=block_size, compute_dtype=compute_dtype)
+
+        decode_cache_shape = (cfg.num_layers, cfg.num_heads,
+                              cfg.model_dim // cfg.num_heads)
+
     return Model(name=cfg.name, init=init, apply=apply,
                  loss=transformer.loss_fn, accuracy=transformer.accuracy,
                  input_shape=(cfg.seq_len,), input_dtype=jnp.int32,
                  eval_metrics=lm_eval_metrics,
                  predictions=lm_predictions,
+                 decode_prefill=decode_prefill,
+                 decode_step=decode_step_fn,
+                 decode_cache_shape=decode_cache_shape,
                  sharded_apply_factory=sharded_apply_factory,
                  partition_rules=transformer_partition_rules(cfg.num_experts),
                  has_aux=moe, aux_weight=cfg.moe_aux_weight,

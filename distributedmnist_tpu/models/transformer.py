@@ -208,8 +208,13 @@ def apply(params: Params, tokens: jax.Array, *, num_heads: int = 4,
 
 def _attn_sublayer(x: jax.Array, blk: Params, *, h_local: int, hd: int,
                    attn: Callable,
-                   model_axis: str | None) -> jax.Array:
-    """Pre-norm attention sublayer: x + wo(attn(qkv(ln1(x))))."""
+                   model_axis: str | None,
+                   return_kv: bool = False):
+    """Pre-norm attention sublayer: x + wo(attn(qkv(ln1(x)))).
+
+    ``return_kv``: also return this layer's K/V in the [b, s, h, hd]
+    residual layout (a free reshape) — what the decode prefill scatters
+    into the paged KV cache."""
     b = x.shape[0]
     h = _rms_norm(x, blk["ln1"])
     qkv = jnp.einsum("bsd,dte->bste", h, blk["wqkv"])  # e = d/m
@@ -232,7 +237,11 @@ def _attn_sublayer(x: jax.Array, blk: Params, *, h_local: int, hd: int,
     proj = o @ blk["wo"]  # row-parallel: partial sum of the full d
     if model_axis:
         proj = lax.psum(proj, model_axis)
-    return x + proj
+    out = x + proj
+    if return_kv:
+        return (out, k.reshape(b, -1, h_local, hd),
+                v.reshape(b, -1, h_local, hd))
+    return out
 
 
 def _ffn_sublayer(x: jax.Array, blk: Params, *, model_axis: str | None,
@@ -281,6 +290,118 @@ def _apply_block(x: jax.Array, blk: Params, *, h_local: int, hd: int,
                          moe_num_groups=moe_num_groups,
                          moe_router_top_k=moe_router_top_k,
                          moe_stats_axes=moe_stats_axes)
+
+
+# ---------------------------------------------------------------------------
+# Autoregressive decode: prompt prefill with K/V export + one-token
+# incremental step over a paged KV cache (servesvc/decode.py)
+# ---------------------------------------------------------------------------
+
+_DECODE_NEG = -1e30  # finite mask value: an all-masked idle slot's
+# softmax degrades to uniform-over-garbage (ignored) instead of NaN
+
+
+def prefill_with_kv(params: Params, tokens: jax.Array, *,
+                    num_heads: int = 4,
+                    attention_fn: Callable | None = None,
+                    positions: jax.Array | None = None,
+                    compute_dtype=jnp.bfloat16
+                    ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Prompt prefill: the standard causal forward (through the
+    CONFIGURED attention kernel — the fused pallas flash path or dense)
+    that also returns every layer's K/V for seeding a decode cache.
+
+    tokens [b, s] int32 → (logits [b, s, vocab] float32,
+    k [L, b, s, h, hd], v [L, b, s, h, hd]) with K/V in the compute
+    dtype (the cache dtype). Dense-FFN models only (MoE routing is
+    batch-shaped; the registry never exports decode for it)."""
+    attn = attention_fn or local_self_attention
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.arange(s)
+    p = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+    x = p["embed"][tokens] + p["pos"][positions]
+    d = x.shape[-1]
+    hd = d // num_heads
+    ks, vs = [], []
+    for blk in p["blocks"]:
+        x, k, v = _attn_sublayer(x, blk, h_local=num_heads, hd=hd,
+                                 attn=attn, model_axis=None,
+                                 return_kv=True)
+        ks.append(k)
+        vs.append(v)
+        x, _ = _ffn_sublayer(x, blk, model_axis=None)
+    x = _rms_norm(x, p["final_norm"])
+    logits = (x @ p["embed"].T).astype(jnp.float32)
+    return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+def decode_step(params: Params, tokens: jax.Array, positions: jax.Array,
+                k_cache: jax.Array, v_cache: jax.Array,
+                block_tables: jax.Array, lengths: jax.Array, *,
+                num_heads: int = 4, block_size: int = 16,
+                compute_dtype=jnp.bfloat16
+                ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One incremental decode step over S slots sharing one paged KV
+    cache — the single compiled shape every in-flight sequence runs
+    in, whatever its length.
+
+    * ``tokens`` [S] int32 — each slot's newest token,
+    * ``positions`` [S] — that token's 0-based sequence position,
+    * ``k_cache``/``v_cache`` [L, N, B, h, hd] — the paged cache
+      (N blocks of B positions; block 0 is the reserved null block),
+    * ``block_tables`` [S, P] int32 — each slot's position→block map
+      (idle slots: all zeros),
+    * ``lengths`` [S] — context length INCLUDING this token
+      (``positions + 1``; 0 for idle slots, whose rows compute masked
+      garbage the caller ignores).
+
+    Returns (logits [S, vocab] float32, k_cache, v_cache) with this
+    token's K/V written at its block/offset. Attention numerics match
+    ``local_self_attention`` (f32 scores/softmax, 1/sqrt(hd) scale),
+    so greedy decode through the cache reproduces the full-context
+    forward (pinned in tests/test_decode.py)."""
+    p = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+    num_slots = tokens.shape[0]
+    x = p["embed"][tokens] + p["pos"][positions]  # [S, d]
+    d = x.shape[-1]
+    hd = d // num_heads
+    scale = 1.0 / (hd ** 0.5)
+    ctx = block_tables.shape[1] * block_size
+    ctx_pos = jnp.arange(ctx)
+    blk_ids = jnp.take_along_axis(
+        block_tables, (positions // block_size)[:, None], axis=1)[:, 0]
+    offs = positions % block_size
+    live = ctx_pos[None, :] < lengths[:, None]  # [S, ctx]
+    for li, blk in enumerate(p["blocks"]):
+        h = _rms_norm(x, blk["ln1"])
+        qkv = jnp.einsum("sd,dte->ste", h, blk["wqkv"])
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [S, d]
+        kh = k.reshape(num_slots, num_heads, hd)
+        vh = v.reshape(num_slots, num_heads, hd)
+        k_cache = k_cache.at[li, blk_ids, offs].set(
+            kh.astype(k_cache.dtype))
+        v_cache = v_cache.at[li, blk_ids, offs].set(
+            vh.astype(v_cache.dtype))
+        # gather the slot's pages into one dense context view: the
+        # block table IS the indirection, so this read is identical
+        # for a 3-token and a 90-token sequence — one compiled shape
+        kp = k_cache[li][block_tables].reshape(
+            num_slots, ctx, num_heads, hd)
+        vp = v_cache[li][block_tables].reshape(
+            num_slots, ctx, num_heads, hd)
+        qh = q.reshape(num_slots, num_heads, hd)
+        scores = jnp.einsum("shd,skhd->shk", qh.astype(jnp.float32),
+                            kp.astype(jnp.float32)) * scale
+        scores = jnp.where(live[:, None, :], scores, _DECODE_NEG)
+        w = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("shk,skhd->shd", w, vp.astype(jnp.float32))
+        o = o.astype(compute_dtype).reshape(num_slots, d)
+        x = x + o @ blk["wo"]
+        x, _ = _ffn_sublayer(x, blk, model_axis=None)
+    x = _rms_norm(x, p["final_norm"])
+    logits = (x @ p["embed"].T).astype(jnp.float32)
+    return logits, k_cache, v_cache
 
 
 # ---------------------------------------------------------------------------

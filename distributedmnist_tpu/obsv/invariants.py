@@ -41,6 +41,15 @@ survived fault schedule must satisfy:
    world-size-independent, so a fully recovered resized trial still
    reproduces the fault-free reference digest exactly.
 
+Serving trials add four more (:func:`check_serving`): 7.
+**serve_outcomes** (exactly one terminal outcome per issued request),
+8. **serve_digest** (never serve a torn publish), 9.
+**serve_monotone** (served step never goes backwards), and 10.
+**decode_swap** (a weight swap mid-generation is licensed: a sequence
+finishing on a different model step than it started on must hold a
+journaled ``seq_restart``, and every restart must follow its
+``weight_swap``).
+
 No cluster, supervisor, or trainer state is consulted — a report over
 downloaded artifacts is as checkable as a live run, which is what lets
 the chaos campaign shrink failing schedules by re-running and
@@ -65,7 +74,8 @@ from .report import load_jsonl
 
 INVARIANTS = ("terminal_state", "metrics_log", "determinism",
               "causality", "checkpoint_integrity", "reconfigure",
-              "serve_outcomes", "serve_digest", "serve_monotone")
+              "serve_outcomes", "serve_digest", "serve_monotone",
+              "decode_swap")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -442,11 +452,13 @@ def _ckpt_name_step(name: str) -> int | None:
 
 def check_serving(trial_dir: str | Path, outcome: dict,
                   journal_records: list[dict]
-                  ) -> tuple[list[Violation], bool, set[int]]:
-    """The three serving invariants, replayed from artifacts alone.
-    Returns ``(violations, applicable, serve_workers)`` — not
-    applicable (all three verdicts: skipped) for trials with no
-    serving tier.
+                  ) -> tuple[list[Violation], bool, set[int], bool]:
+    """The serving invariants, replayed from artifacts alone.
+    Returns ``(violations, applicable, serve_workers,
+    decode_applicable)`` — not applicable (all verdicts: skipped) for
+    trials with no serving tier; ``decode_applicable`` True only when
+    some replica's journal shows the decode workload (the
+    ``decode_swap`` invariant is skipped otherwise).
 
     * **serve_outcomes** — every request the load generator issued has
       EXACTLY one terminal outcome (response or typed reject/error; no
@@ -469,6 +481,16 @@ def check_serving(trial_dir: str | Path, outcome: dict,
     * **serve_monotone** — each replica's journaled ``weight_swap``
       step series is monotone non-decreasing (across restarts too: the
       publisher's steps only advance).
+    * **decode_swap** — swap-during-generation bookkeeping (decode
+      replicas, invariant 10): a sequence that finishes on a model
+      step other than the one it started on (``decode_finish``'s
+      ``model_step`` vs ``started_step``) must hold a journaled
+      ``seq_restart`` license for that id — the restart policy's
+      re-prefill — and every ``seq_restart``'s target step must be
+      licensed by an earlier journaled ``weight_swap`` to that step.
+      Under the pin policy no sequence ever changes step mid-flight,
+      so any unlicensed drift is a replica serving mixed weights —
+      the silent-corruption mode this invariant exists to catch.
     """
     trial_dir = Path(trial_dir)
     serve_workers = {int(k) for k in (outcome.get("serve_workers") or [])}
@@ -480,8 +502,9 @@ def check_serving(trial_dir: str | Path, outcome: dict,
     loadgen = trial_dir / "loadgen.jsonl"
     applicable = bool(serve_workers) or loadgen.exists()
     if not applicable:
-        return [], False, set()
+        return [], False, set(), False
     out: list[Violation] = []
+    decode_applicable = False
 
     # ---- (a) client side: issued ↔ exactly-one-terminal ----------------
     load_records = load_jsonl(loadgen, schema.LOAD)
@@ -533,8 +556,11 @@ def check_serving(trial_dir: str | Path, outcome: dict,
                 "at all", k))
             continue
         # ---- (a) server side: admits ↔ admitted terminals ------------
+        # (a classification replica's terminal is "respond", a decode
+        # replica's is "decode_finish" — both close an admit)
         admits = sum(1 for r in recs if r.get("action") == "admit")
-        responds = sum(1 for r in recs if r.get("action") == "respond")
+        responds = sum(1 for r in recs
+                       if r.get("action") in ("respond", "decode_finish"))
         admitted_rejects = sum(1 for r in recs
                                if r.get("action") == "reject"
                                and r.get("admitted"))
@@ -605,7 +631,46 @@ def check_serving(trial_dir: str | Path, outcome: dict,
                     f"{prev} -> {step}", k))
                 break
             prev = step
-    return out, True, serve_workers
+        # ---- (d) swap-during-generation (decode replicas) ------------
+        # One ordered pass over the journal: the license must EXIST
+        # BEFORE it is used (a seq_restart must follow the weight_swap
+        # it targets; a drifted finish must follow ITS OWN sequence's
+        # restart), and a license is consumed at the finish — request
+        # ids recycle across sweeps in one journal, so a stale restart
+        # from an earlier generation must not launder a later one's
+        # mixed-weights finish.
+        if any(r.get("action") in ("decode_start", "decode_finish",
+                                   "seq_restart") for r in recs):
+            decode_applicable = True
+            seen_swap_steps: set = set()
+            licensed_to: dict = {}  # id -> to_step of its live restart
+            for r in recs:
+                action = r.get("action")
+                if action == "weight_swap":
+                    seen_swap_steps.add(r.get("step"))
+                elif action == "seq_restart":
+                    if r.get("to_step") not in seen_swap_steps:
+                        out.append(Violation(
+                            "decode_swap",
+                            f"seq_restart of {r.get('id')!r} targets "
+                            f"step {r.get('to_step')} before any "
+                            "journaled weight_swap to that step — a "
+                            "restart without its causal swap", k))
+                    licensed_to[r.get("id")] = r.get("to_step")
+                elif action == "decode_finish":
+                    st, ms = r.get("started_step"), r.get("model_step")
+                    if (isinstance(st, int) and isinstance(ms, int)
+                            and st != ms
+                            and licensed_to.get(r.get("id")) != ms):
+                        out.append(Violation(
+                            "decode_swap",
+                            f"sequence {r.get('id')!r} finished on "
+                            f"model step {ms} but started on {st} with "
+                            "no live seq_restart license to that step "
+                            "— the replica served mixed weights "
+                            "mid-generation", k))
+                    licensed_to.pop(r.get("id"), None)
+    return out, True, serve_workers, decode_applicable
 
 
 # ---------------------------------------------------------------------------
@@ -686,12 +751,16 @@ def check_run(trial_dir: str | Path, outcome: dict | None = None,
     violations += reconf_violations
     if not reconf_applicable:
         skipped.add("reconfigure")
-    serve_violations, serving_applicable, serve_workers = \
-        check_serving(trial_dir, outcome, journal_all)
+    serve_violations, serving_applicable, serve_workers, \
+        decode_applicable = check_serving(trial_dir, outcome, journal_all)
     violations += serve_violations
     if not serving_applicable:
         skipped.update(("serve_outcomes", "serve_digest",
                         "serve_monotone"))
+    if not decode_applicable:
+        # only trials whose replicas ran the decode workload make the
+        # swap-during-generation claim
+        skipped.add("decode_swap")
 
     restarts_by_worker: dict[int, int] = {}
     for r in recovery:

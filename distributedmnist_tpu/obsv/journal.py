@@ -241,6 +241,10 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
                 "reject_rate": sv.get("reject_rate"),
                 "p50_ms": (sv.get("latency_ms") or {}).get("p50"),
                 "p99_ms": (sv.get("latency_ms") or {}).get("p99"),
+                # decode sweeps: tokens actually streamed and the
+                # time-to-first-token tail (None on classify trials)
+                "tokens_streamed": sv.get("tokens_streamed"),
+                "ttft_p99_ms": (sv.get("ttft_ms") or {}).get("p99"),
                 "model_steps_served": sv.get("model_steps_served"),
                 "tiers_served": sv.get("tiers_served"),
                 "serve_swaps": rec.get("serve_swaps")})
@@ -336,6 +340,14 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
                 "responses": sum(t["responses"] or 0
                                  for t in serving_trials),
                 "errors": sum(t["errors"] or 0 for t in serving_trials),
+                # decode campaigns: total generated tokens + the worst
+                # per-trial time-to-first-token tail (the decode
+                # latency split the loadgen records per request)
+                "tokens_streamed": sum(t["tokens_streamed"] or 0
+                                       for t in serving_trials),
+                "ttft_p99_ms": max(
+                    (t["ttft_p99_ms"] for t in serving_trials
+                     if t["ttft_p99_ms"] is not None), default=None),
                 # which precision tier each installed swap served
                 # (tier-less legacy swaps counted as fp32) and how
                 # often a quantized replica's sidecar preference fell

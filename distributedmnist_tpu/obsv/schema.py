@@ -192,7 +192,21 @@ _declare(EventSchema(
         "weight_swap": _act(("step", "from_step", "digest", "tier",
                              "source_artifact", "source_digest",
                              "swap_ms"),
-                            ("initial",)),
+                            ("initial", "sequences_pinned",
+                             "sequences_restarted")),
+        # -- decode service (servesvc/decode.py) ----------------------
+        "decode_start": _act(("slots", "block_size", "num_blocks",
+                              "max_prompt_len", "max_new_tokens",
+                              "swap_policy", "model_step")),
+        "prefill": _act(("id", "prompt_len", "bucket", "blocks",
+                         "model_step", "ttft_ms"),
+                        ("restart",)),
+        "decode_finish": _act(("id", "reason", "tokens_streamed",
+                               "model_step", "started_step",
+                               "latency_ms"),
+                              ("ttft_ms", "restarts")),
+        "seq_restart": _act(("id", "from_step", "to_step",
+                             "tokens_discarded")),
         "follow_quant_sidecar_fallback": _act(("step", "tier",
                                                "reason")),
         "follow_skip": _act(("step", "error")),
@@ -244,7 +258,9 @@ _declare(EventSchema(
         "issue": _act(),
         "outcome": _act(("status",),
                         ("reason", "model_step", "tier", "attempts",
-                         "endpoint", "latency_ms")),
+                         "endpoint", "latency_ms",
+                         # decode sweeps: the two-number latency split
+                         "ttft_ms", "itl_ms", "tokens")),
     },
 ))
 

@@ -306,6 +306,26 @@ class QuantPublisher:
                            "sharded layout (quantize from a restored "
                            "template instead)", step)
             return None
+        src_digest = tree_params_digest(params_sd)
+        try:
+            # idempotent per (step, source digest, tier set): the
+            # final save at max_steps re-triggers the cadence step's
+            # publish when the async writer drained between the two
+            # enqueues — identical params must not pay the pass (or
+            # bump the telemetry) twice. A different digest (same-step
+            # re-save after a rollback) OR a tier the existing sidecar
+            # lacks (re-publish under a widened quant.publish_tiers)
+            # still republishes.
+            existing = ckpt.read_quant_sidecar(train_dir, step)
+            meta = existing.get("meta") or {}
+            if (meta.get("source_params_digest") == src_digest
+                    and set(self.tiers) <= set(meta.get("tiers") or ())):
+                logger.info("quant sidecar step=%d already published "
+                            "for this source digest + tiers; skipping",
+                            step)
+                return meta
+        except (OSError, ValueError, KeyError):
+            pass  # absent/torn sidecar: publish (re-)writes it
         t0 = time.perf_counter()
         built: dict[str, Any] = {}
         for tier in self.tiers:
@@ -314,7 +334,7 @@ class QuantPublisher:
         meta: dict[str, Any] = {
             "step": step,
             "tiers": list(built),
-            "source_params_digest": tree_params_digest(params_sd),
+            "source_params_digest": src_digest,
             "parity_epsilon": self.qcfg.parity_epsilon,
             "param_bytes": {"fp32": tier_param_bytes(params_sd),
                             **{t: tier_param_bytes(tr)

@@ -22,8 +22,11 @@ another resource shape behind one recovery surface.
 """
 
 from .client import ServeClient, discover_endpoints
+from .decode import DecodeReplica
+from .kv_cache import BlockAllocator, PagedKVCache
 from .loadgen import run_load
 from .server import ServingReplica
 
-__all__ = ["ServingReplica", "ServeClient", "discover_endpoints",
-           "run_load"]
+__all__ = ["ServingReplica", "DecodeReplica", "ServeClient",
+           "discover_endpoints", "run_load", "BlockAllocator",
+           "PagedKVCache"]

@@ -100,12 +100,18 @@ class ServeClient:
                 buf += chunk
             return json.loads(buf.decode())
 
-    def request(self, inputs, request_id=None,
-                deadline_s: float | None = None) -> dict:
-        """One request → one terminal outcome dict (never raises for
-        server/network trouble; see module docstring). The outcome
-        carries ``latency_ms``, ``attempts``, and the answering
-        replica's ``endpoint`` when one answered."""
+    def _failover_loop(self, request_id,
+                       deadline_s: float | None, attempt) -> dict:
+        """The ONE failover/retry engine both request shapes share:
+        rotate endpoints, bound attempts and the deadline, back off
+        between tries, and classify outcomes — ``attempt(host, port,
+        remaining, attempts, t0)`` performs one wire exchange and
+        returns the raw response dict (raising ``OSError``/
+        ``ValueError`` for a dead/garbled replica). A retryable typed
+        reject (``overloaded``/``shutting_down``: that replica shed
+        load, a sibling may have room) costs one attempt; anything
+        else terminal is returned enriched with ``attempts``/
+        ``endpoint``/``latency_ms``."""
         deadline_s = self.deadline_s if deadline_s is None else deadline_s
         t0 = time.time()
         deadline = t0 + deadline_s
@@ -123,12 +129,8 @@ class ServeClient:
                 continue
             host, port = ep
             attempts += 1
-            req = {"id": request_id, "inputs": inputs,
-                   "deadline_ms": round(remaining * 1e3, 1)}
             try:
-                resp = self._one_attempt(
-                    (json.dumps(req) + "\n").encode(), host, port,
-                    timeout_s=remaining)
+                resp = attempt(host, port, remaining, attempts, t0)
             except (OSError, ValueError) as e:
                 logger.debug("attempt %d via %s:%d failed: %s",
                              attempts, host, port, e)
@@ -139,21 +141,130 @@ class ServeClient:
             out = {**resp, "attempts": attempts,
                    "endpoint": f"{host}:{port}",
                    "latency_ms": round((time.time() - t0) * 1e3, 3)}
-            if status == "ok":
-                return out
             if (status == "rejected"
                     and resp.get("reason") in RETRYABLE_REJECTS):
-                # that replica shed load / is draining — its sibling
-                # may have room; the budget bounds how long we hedge
                 time.sleep(min(self.backoff_s * attempts,
                                max(0.0, deadline - time.time())))
                 continue
-            if status == "rejected":
-                return out  # typed, non-retryable — terminal
-            return out      # unknown status: surface it verbatim
+            return out  # ok / typed non-retryable / unknown: terminal
         return {"id": request_id, "status": "error", "reason": last_reason,
                 "attempts": attempts,
                 "latency_ms": round((time.time() - t0) * 1e3, 3)}
+
+    def request(self, inputs, request_id=None,
+                deadline_s: float | None = None) -> dict:
+        """One request → one terminal outcome dict (never raises for
+        server/network trouble; see module docstring). The outcome
+        carries ``latency_ms``, ``attempts``, and the answering
+        replica's ``endpoint`` when one answered."""
+        def attempt(host, port, remaining, attempts, t0):
+            req = {"id": request_id, "inputs": inputs,
+                   "deadline_ms": round(remaining * 1e3, 1)}
+            return self._one_attempt(
+                (json.dumps(req) + "\n").encode(), host, port,
+                timeout_s=remaining)
+
+        return self._failover_loop(request_id, deadline_s, attempt)
+
+    def _stream_attempt(self, payload: bytes, host: str, port: int,
+                        timeout_s: float,
+                        on_token) -> tuple[dict, list[float], list]:
+        """One streaming attempt: send, then read token lines until
+        the terminal line. Returns (terminal, token_times, tokens)."""
+        with socket.create_connection((host, port),
+                                      timeout=timeout_s) as conn:
+            conn.settimeout(timeout_s)
+            conn.sendall(payload)
+            buf = b""
+            tokens: list = []
+            token_times: list[float] = []
+            while True:
+                while b"\n" not in buf:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        raise OSError("connection closed mid-stream")
+                    buf += chunk
+                line, _, buf = buf.partition(b"\n")
+                rec = json.loads(line.decode())
+                if "status" in rec:
+                    return rec, token_times, tokens
+                if rec.get("stream") == "token":
+                    tokens.append(rec.get("token"))
+                    token_times.append(time.time())
+                    if on_token is not None:
+                        on_token(rec)
+                elif rec.get("stream") == "restart":
+                    # a server-side swap-policy restart: the replica
+                    # regenerates on new weights — reset accumulation
+                    tokens = []
+                    token_times = []
+                    if on_token is not None:
+                        on_token(rec)
+
+    def generate(self, prompt, request_id=None,
+                 deadline_s: float | None = None,
+                 max_tokens: int | None = None,
+                 temperature: float | None = None,
+                 top_k: int | None = None,
+                 on_token=None) -> dict:
+        """One generation request → one terminal outcome dict, with
+        tokens streamed through ``on_token`` as they arrive. Same
+        failover/retry/terminal semantics as :meth:`request` (the
+        shared :meth:`_failover_loop`); a connection lost MID-STREAM
+        costs one attempt and the whole generation retries on a
+        sibling — accumulated tokens reset, and ``on_token`` receives
+        the same ``{"stream": "restart"}`` signal a server-side
+        swap-restart sends, so a consumer never keeps a duplicated
+        prefix. The outcome carries ``ttft_ms`` (first token latency),
+        ``itl_ms`` (mean inter-token gap) and ``tokens`` alongside the
+        usual ``latency_ms``/``attempts``/``endpoint``."""
+        def attempt(host, port, remaining, attempts, t0):
+            req: dict[str, Any] = {"id": request_id, "prompt": prompt,
+                                   "deadline_ms": round(remaining * 1e3,
+                                                        1)}
+            if max_tokens is not None:
+                req["max_tokens"] = max_tokens
+            if temperature is not None:
+                req["temperature"] = temperature
+            if top_k is not None:
+                req["top_k"] = top_k
+            attempt_t0 = time.time()
+            streamed_any = False
+
+            def _tap(rec):
+                nonlocal streamed_any
+                streamed_any = True
+                if on_token is not None:
+                    on_token(rec)
+
+            try:
+                resp, token_times, tokens = self._stream_attempt(
+                    (json.dumps(req) + "\n").encode(), host, port,
+                    timeout_s=remaining, on_token=_tap)
+            except (OSError, ValueError):
+                if streamed_any and on_token is not None:
+                    # client-side failover mid-stream: the next
+                    # attempt regenerates from scratch on a sibling —
+                    # give the consumer the protocol's own reset
+                    # signal, or its accumulated prefix silently
+                    # duplicates
+                    on_token({"id": request_id, "stream": "restart",
+                              "reason": "failover"})
+                raise
+            if resp.get("status") == "ok":
+                resp.setdefault("tokens", tokens)
+                resp["tokens_streamed"] = len(resp["tokens"] or [])
+                if token_times:
+                    resp["ttft_ms"] = round(
+                        (token_times[0] - attempt_t0) * 1e3, 3)
+                if len(token_times) > 1:
+                    gaps = [b - a for a, b in zip(token_times,
+                                                  token_times[1:])]
+                    resp["itl_ms"] = round(
+                        sum(gaps) / len(gaps) * 1e3, 3)
+            return resp
+
+        return self._failover_loop(request_id, deadline_s, attempt)
 
     def meta(self, deadline_s: float | None = None) -> dict | None:
         """Model metadata from any live replica (input shape/dtype —

@@ -1,0 +1,497 @@
+"""Continuous-batching autoregressive decode replica.
+
+The generation face of the serving tier: same replica contract as
+:class:`~.server.ServingReplica` (supervised process, bounded
+admission queue, heartbeats, digest-verified weight follow, typed
+rejects, zero-drop teardown) with the workload inside it changed from
+one-shot classification to streaming decode — the
+resource-shape-agnostic-replica move (arXiv:1902.00465): the
+supervisor, chaos schedules and invariants apply unchanged.
+
+**Continuous batching.** The replica holds ``decode.decode_slots``
+concurrently-generating sequences. Each loop iteration runs ONE
+compiled decode step over all of them — a fixed ``[slots]`` shape
+whatever mix of lengths is in flight, because every sequence reads its
+K/V through its block table over the shared paged cache
+(:mod:`.kv_cache`). A sequence that finishes (EOS / max_tokens /
+deadline / client gone) frees its blocks and its slot is refilled from
+the admission queue the SAME iteration — no padded rounds, no waiting
+for a batch to drain.
+
+**Prefill.** Prompts are admitted through the existing bounded queue
+(typed ``overloaded`` shed when full), padded to power-of-2 buckets
+(each bucket's prefill compiles once) and run through the model's
+``decode_prefill`` export — the standard causal forward through the
+CONFIGURED attention kernel (the fused pallas flash path when
+``model.attention_impl=flash``) that also returns every layer's K/V,
+scattered into the sequence's blocks. The first token samples off the
+prefill logits: time-to-first-token is one prefill, not a decode-queue
+wait.
+
+**Weight swaps mid-generation.** The checkpoint follower stages
+digest-verified publishes exactly as the classification replica does;
+the flip happens at a decode-loop boundary under a declared policy
+(``decode.swap_policy``):
+
+* ``pin`` — every in-flight sequence keeps generating on the params it
+  started with until it finishes; new admissions use the new weights.
+  At most a handful of param versions are live (bounded by slots), and
+  a version is dropped the moment its last pinned sequence finishes.
+* ``restart`` — every in-flight sequence is re-prefilled on the new
+  weights (its streamed tokens are discarded; the stream carries an
+  explicit ``restart`` marker so clients reset), journaled per
+  sequence as ``seq_restart``.
+
+Either way the swap record grows ``sequences_pinned`` /
+``sequences_restarted``, and the ``decode_swap`` replay invariant
+(obsv/invariants.py, invariant 10) checks the books: a sequence that
+finishes on a different model step than it started on MUST hold a
+journaled ``seq_restart`` license, and every ``seq_restart`` must
+follow a journaled ``weight_swap`` to its target step.
+
+Wire protocol (one connection per request, line-delimited JSON):
+
+  request:  {"id": ..., "prompt": [int, ...], "max_tokens": N,
+             "temperature": t, "top_k": k, "deadline_ms": ...}
+  stream:   {"id": ..., "stream": "token", "token": t, "index": i,
+             "model_step": s}        (one line per generated token)
+            {"id": ..., "stream": "restart", "model_step": s}
+            (key "stream", not "event" — journal records own that key)
+  terminal: {"id": ..., "status": "ok", "tokens": [...],
+             "finish_reason": "eos" | "max_tokens" | "deadline" |
+             "client_gone", "model_step": s, "started_step": s0}
+            {"id": ..., "status": "rejected", "reason": ...}
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import json
+import queue
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.config import ConfigError
+from ..models.registry import sample_token
+from .kv_cache import PagedKVCache
+from .server import ServingReplica, _Pending
+
+
+class _DecodeSeq(_Pending):
+    """One in-flight generation (``inputs`` holds the prompt)."""
+
+    __slots__ = ("max_tokens", "temperature", "top_k", "block_table",
+                 "length", "tokens", "params_step", "started_step",
+                 "first_token_at", "restarts", "conn_dead", "sample_seed")
+
+    def __init__(self, req_id, prompt, conn, admitted_at, deadline_at):
+        super().__init__(req_id, prompt, conn, admitted_at, deadline_at)
+        self.max_tokens = 0
+        self.temperature = 0.0
+        self.top_k = 0
+        self.block_table = None
+        self.length = 0            # context tokens written to the cache
+        self.tokens: list[int] = []
+        self.params_step = -1
+        self.started_step = -1
+        self.first_token_at: float | None = None
+        self.restarts = 0
+        self.conn_dead = False
+        self.sample_seed = 0
+
+
+class DecodeReplica(ServingReplica):
+    """Hot-follow published checkpoints and stream autoregressive
+    generations with continuous batching over a paged KV cache."""
+
+    def __init__(self, train_dir, serve_dir=".", scfg=None, dcfg=None,
+                 cfg=None, topo=None):
+        super().__init__(train_dir, serve_dir=serve_dir, scfg=scfg,
+                         cfg=cfg, topo=topo)
+        if self.tier != "fp32":
+            raise ConfigError(
+                f"serve.precision_tier={self.tier!r}: the decode "
+                "service serves full precision only (quant sidecars "
+                "hold weights for the one-shot predict export, not the "
+                "decode graph)")
+        if (self.model.decode_prefill is None
+                or self.model.decode_step is None):
+            raise ConfigError(
+                f"model {self.cfg.model.name!r} exports no decode step "
+                "(decode needs a dense-FFN causal LM; MoE and "
+                "classifier families have no incremental export)")
+        self.dcfg = dcfg or self.cfg.decode
+        self.dcfg.validate()
+        if (self.dcfg.max_prompt_len + self.dcfg.max_new_tokens
+                > self.cfg.model.seq_len):
+            raise ConfigError(
+                f"decode.max_prompt_len + decode.max_new_tokens = "
+                f"{self.dcfg.max_prompt_len + self.dcfg.max_new_tokens} "
+                f"exceeds model.seq_len={self.cfg.model.seq_len} (the "
+                "learned position table is the hard context ceiling)")
+        from ..core.config import effective_model_config
+        dtype = jnp.dtype(
+            effective_model_config(self.cfg, serving=True).compute_dtype)
+        layers, heads, head_dim = self.model.decode_cache_shape
+        self.cache = PagedKVCache(
+            layers, self.dcfg.num_blocks, self.dcfg.block_size,
+            heads, head_dim, self.dcfg.max_blocks_per_seq(), dtype=dtype)
+        self._prefill_jit = jax.jit(self.model.decode_prefill)
+        # the cache arrays are rebound to the step's outputs at every
+        # call site — donate them so XLA updates in place instead of
+        # copying the whole [L, N, B, h, hd] pair per generated token
+        self._decode_jit = jax.jit(
+            functools.partial(self.model.decode_step,
+                              block_size=self.dcfg.block_size),
+            donate_argnums=(3, 4))
+        # decode-loop-owned state (single writer: the batcher thread)
+        self._slots: list[_DecodeSeq | None] = (
+            [None] * self.dcfg.decode_slots)
+        self._waiting: collections.deque[_DecodeSeq] = collections.deque()
+        self._versions: dict[int, object] = {}  # pinned old params
+        self._seq_counter = 0
+        self.tokens_streamed = 0
+        self.sequences_finished = 0
+
+    # -- admission ------------------------------------------------------
+
+    def _build_item(self, req: dict, conn):
+        req_id = req.get("id")
+        try:
+            prompt = np.asarray(req["prompt"], dtype=np.int32)
+            if (prompt.ndim != 1 or prompt.size < 1
+                    or prompt.size > self.dcfg.max_prompt_len):
+                raise ValueError("prompt length out of range")
+            if (int(prompt.min()) < 0
+                    or int(prompt.max()) >= self.cfg.model.vocab_size):
+                raise ValueError("token id out of vocab")
+            max_tokens = int(req.get("max_tokens",
+                                     self.dcfg.max_new_tokens))
+            if not 1 <= max_tokens <= self.dcfg.max_new_tokens:
+                raise ValueError("max_tokens out of range")
+            temperature = float(req.get("temperature",
+                                        self.dcfg.temperature))
+            top_k = int(req.get("top_k", self.dcfg.top_k))
+        except (KeyError, ValueError, TypeError):
+            self._reject(conn, req_id, "bad_request", admitted=False)
+            return None
+        now = time.time()
+        deadline_ms = req.get("deadline_ms",
+                              self.scfg.default_deadline_ms)
+        # streaming sends run on the SINGLE decode-loop thread: a
+        # client that stopped reading must cost the loop a short
+        # bounded stall ONCE (then conn_dead), never the accept-side
+        # 5 s timeout per token — one stalled reader must not freeze
+        # every other slot's generation
+        try:
+            conn.settimeout(0.5)
+        except OSError:
+            pass
+        seq = _DecodeSeq(req_id, prompt, conn, now,
+                         now + float(deadline_ms) / 1e3)
+        seq.max_tokens = max_tokens
+        seq.temperature = temperature
+        seq.top_k = top_k
+        return seq
+
+    # -- weights: version registry + swap policies ----------------------
+
+    def _params_for(self, step: int):
+        return (self._params if step == self.model_step
+                else self._versions[step])
+
+    def _release_version(self, step: int) -> None:
+        if step == self.model_step or step not in self._versions:
+            return
+        if not any(s is not None and s.params_step == step
+                   for s in self._slots):
+            del self._versions[step]
+
+    def _maybe_swap(self) -> None:
+        """Decode-loop-boundary flip under the declared mid-generation
+        policy; journals the swap with its per-sequence bookkeeping."""
+        with self._staged_lock:
+            staged, self._staged = self._staged, None
+        if staged is None:
+            return
+        install, t0 = staged
+        if install["step"] <= self.model_step:
+            return  # monotone: never swap backwards
+        in_flight = [s for s in self._slots if s is not None]
+        prev_step = self.model_step
+        pinned = restarted = 0
+        if in_flight:
+            if self.dcfg.swap_policy == "pin":
+                pinned = len(in_flight)
+                if any(s.params_step == prev_step for s in in_flight):
+                    # stash only a version something actually runs on:
+                    # back-to-back swaps with everything pinned to an
+                    # even older version must not leak the middle one
+                    self._versions[prev_step] = self._params
+            else:
+                restarted = len(in_flight)
+        self._install(install, t0,
+                      extra={"sequences_pinned": pinned,
+                             "sequences_restarted": restarted})
+        if restarted:
+            for s in in_flight:
+                self._restart_seq(s, prev_step)
+
+    def _restart_seq(self, s: _DecodeSeq, from_step: int) -> None:
+        """The restart policy's per-sequence move: discard what the old
+        params generated, re-prefill on the new — journaled as the
+        causal license the decode_swap invariant requires."""
+        self._journal({"action": "seq_restart", "id": s.req_id,
+                       "from_step": from_step,
+                       "to_step": self.model_step,
+                       "tokens_discarded": len(s.tokens)})
+        self._send_line(s, {"id": s.req_id, "stream": "restart",
+                            "model_step": self.model_step})
+        s.tokens = []
+        s.length = 0
+        s.restarts += 1
+        s.params_step = self.model_step
+        # ttft is a property of the stream the client KEEPS: the
+        # pre-restart first token was discarded, so the journaled
+        # decode_finish must time the post-restart one (matching what
+        # the client-side loadgen measures after its reset)
+        s.first_token_at = None
+        self._prefill(s, restart=True)
+
+    # -- the decode loop ------------------------------------------------
+
+    def _batch_loop(self) -> None:  # overrides the classification batcher
+        while not self._stop.is_set():
+            self._maybe_swap()
+            self._admit_new()
+            self._step_active()
+            self._maybe_heartbeat()
+        # graceful drain: in-flight generations, deferred admissions
+        # and everything still queued get a TYPED terminal — a
+        # stopping replica sheds, it never silently drops
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                self._slots[i] = None
+                self.cache.free_sequence(s.block_table)
+                self._reject(s.conn, s.req_id, "shutting_down",
+                             admitted=True)
+        while self._waiting:
+            s = self._waiting.popleft()
+            self._reject(s.conn, s.req_id, "shutting_down", admitted=True)
+        while True:
+            try:
+                it = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            self._reject(it.conn, it.req_id, "shutting_down",
+                         admitted=True)
+        self._maybe_heartbeat()
+
+    def _admit_new(self) -> None:
+        """Refill free slots from the admission queue. Block pressure
+        (the free list cannot hold another worst-case sequence) defers
+        the admission — bounded by the request's own deadline — rather
+        than evicting a running generation."""
+        idle = (not self._waiting
+                and all(s is None for s in self._slots))
+        try:
+            # idle: park briefly on the queue instead of spinning.
+            # _waiting is capped at the slot count — anything beyond
+            # stays in the BOUNDED socket queue, so sustained block
+            # pressure still sheds typed `overloaded` rejects at
+            # admission instead of growing an unbounded staging line
+            while len(self._waiting) < self.dcfg.decode_slots:
+                self._waiting.append(
+                    self._queue.get(timeout=0.05) if idle
+                    else self._queue.get_nowait())
+                idle = False
+        except queue.Empty:
+            pass
+        while self._waiting:
+            free = next((i for i, s in enumerate(self._slots)
+                         if s is None), None)
+            if free is None:
+                return
+            s = self._waiting[0]
+            if time.time() >= s.deadline_at:
+                self._waiting.popleft()
+                self._reject(s.conn, s.req_id, "deadline_exceeded",
+                             admitted=True)
+                continue
+            table = self.cache.alloc_sequence(
+                int(s.inputs.size) + s.max_tokens)
+            if table is None:
+                return  # block pressure: retry next iteration
+            self._waiting.popleft()
+            s.block_table = table
+            s.params_step = s.started_step = self.model_step
+            s.sample_seed = self._seq_counter
+            self._seq_counter += 1
+            self._slots[free] = s
+            self._prefill(s)
+
+    def _prefill(self, s: _DecodeSeq, restart: bool = False) -> None:
+        """Run the prompt through the model's prefill export (the
+        configured attention kernel), seed the paged cache, and sample
+        + stream the first token."""
+        t0 = time.time()
+        plen = int(s.inputs.size)
+        bucket = self._bucket(plen, self.dcfg.max_prompt_len)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :plen] = s.inputs
+        logits, ks, vs = self._prefill_jit(
+            self._params_for(s.params_step), jnp.asarray(toks))
+        self.cache.write_prompt(s.block_table, ks[:, 0], vs[:, 0], plen)
+        s.length = plen
+        tok = self._sample(s, logits[0, plen - 1])
+        s.tokens.append(tok)
+        self._stream_token(s, tok)
+        rec = {"action": "prefill", "id": s.req_id, "prompt_len": plen,
+               "bucket": bucket,
+               "blocks": int(np.count_nonzero(s.block_table)),
+               "model_step": s.params_step,
+               "ttft_ms": round((time.time() - t0) * 1e3, 3)}
+        if restart:
+            rec["restart"] = True
+        self._journal(rec)
+        self._maybe_finish(self._slots.index(s), s)
+
+    def _step_active(self) -> None:
+        """One decode iteration: a single compiled step per live param
+        version over the fixed slot shape, then per-slot sample /
+        stream / finish — a finished slot is free for the NEXT
+        iteration's refill."""
+        now = time.time()
+        for i, s in enumerate(self._slots):
+            if s is not None and now >= s.deadline_at:
+                self._finish_seq(i, s, "deadline")
+        active = [(i, s) for i, s in enumerate(self._slots)
+                  if s is not None]
+        if not active:
+            return
+        num_slots = self.dcfg.decode_slots
+        width = self.cache.max_blocks_per_seq
+        # pin policy: at most a handful of live versions — one compiled
+        # step per version, idle-for-this-version slots masked via the
+        # null block table + zero length
+        for ver in sorted({s.params_step for _, s in active}):
+            mine = [(i, s) for i, s in active if s.params_step == ver]
+            tokens = np.zeros((num_slots,), np.int32)
+            positions = np.zeros((num_slots,), np.int32)
+            lengths = np.zeros((num_slots,), np.int32)
+            tables = np.zeros((num_slots, width), np.int32)
+            for i, s in mine:
+                tokens[i] = s.tokens[-1]
+                positions[i] = s.length
+                lengths[i] = s.length + 1
+                tables[i] = s.block_table
+            logits, self.cache.k, self.cache.v = self._decode_jit(
+                self._params_for(ver), jnp.asarray(tokens),
+                jnp.asarray(positions), self.cache.k, self.cache.v,
+                jnp.asarray(tables), jnp.asarray(lengths))
+            logits = np.asarray(jax.device_get(logits))
+            for i, s in mine:
+                s.length += 1  # the fed token's K/V is now cached
+                tok = self._sample(s, logits[i])
+                s.tokens.append(tok)
+                self._stream_token(s, tok)
+                self._maybe_finish(i, s)
+
+    def _sample(self, s: _DecodeSeq, logits_row) -> int:
+        if s.temperature <= 0.0:
+            return int(sample_token(jnp.asarray(logits_row)))
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(s.sample_seed),
+            len(s.tokens) + 1000 * s.restarts)
+        return int(sample_token(jnp.asarray(logits_row), key,
+                                temperature=s.temperature,
+                                top_k=s.top_k))
+
+    # -- streaming + termination ----------------------------------------
+
+    def _send_line(self, s: _DecodeSeq, payload: dict) -> None:
+        if s.conn_dead:
+            return
+        try:
+            s.conn.sendall((json.dumps(payload) + "\n").encode())
+        except OSError:
+            s.conn_dead = True  # finish early at the next check
+
+    def _stream_token(self, s: _DecodeSeq, tok: int) -> None:
+        if s.first_token_at is None:
+            s.first_token_at = time.time()
+        self.tokens_streamed += 1
+        self._send_line(s, {"id": s.req_id, "stream": "token",
+                            "token": int(tok),
+                            "index": len(s.tokens) - 1,
+                            "model_step": s.params_step})
+
+    def _maybe_finish(self, i: int, s: _DecodeSeq) -> None:
+        eos = self.dcfg.eos_token
+        if eos >= 0 and s.tokens and s.tokens[-1] == eos:
+            self._finish_seq(i, s, "eos")
+        elif len(s.tokens) >= s.max_tokens:
+            self._finish_seq(i, s, "max_tokens")
+        elif s.conn_dead:
+            self._finish_seq(i, s, "client_gone")
+        elif time.time() >= s.deadline_at:
+            self._finish_seq(i, s, "deadline")
+
+    def _finish_seq(self, i: int, s: _DecodeSeq, reason: str) -> None:
+        """Exactly-one-terminal: journal the finish, send the final
+        line, free the blocks, release the slot (refillable this very
+        iteration) and drop the param version if this was its last
+        pinned sequence."""
+        now = time.time()
+        fields = {"reason": reason, "tokens_streamed": len(s.tokens),
+                  "model_step": s.params_step,
+                  "started_step": s.started_step,
+                  "latency_ms": round((now - s.admitted_at) * 1e3, 3)}
+        if s.first_token_at is not None:
+            fields["ttft_ms"] = round(
+                (s.first_token_at - s.admitted_at) * 1e3, 3)
+        if s.restarts:
+            fields["restarts"] = s.restarts
+        self._terminal("decode_finish", s.req_id, **fields)
+        self._respond(s.conn, {
+            "id": s.req_id, "status": "ok",
+            "tokens": [int(t) for t in s.tokens],
+            "finish_reason": reason, "model_step": s.params_step,
+            "started_step": s.started_step})
+        self._slots[i] = None
+        self.cache.free_sequence(s.block_table)
+        self._release_version(s.params_step)
+        self.sequences_finished += 1
+
+    # -- metadata / lifecycle -------------------------------------------
+
+    def _meta(self) -> dict:
+        return {"status": "ok", "meta": True, "decode": True,
+                "model": self.cfg.model.name,
+                "vocab_size": self.cfg.model.vocab_size,
+                "model_step": self.model_step,
+                "model_digest": self.model_digest,
+                "precision_tier": self.tier,
+                "active_tier": self.model_tier,
+                "decode_slots": self.dcfg.decode_slots,
+                "block_size": self.dcfg.block_size,
+                "num_blocks": self.dcfg.num_blocks,
+                "max_prompt_len": self.dcfg.max_prompt_len,
+                "max_new_tokens": self.dcfg.max_new_tokens,
+                "eos_token": self.dcfg.eos_token,
+                "swap_policy": self.dcfg.swap_policy}
+
+    def start(self) -> None:
+        super().start()
+        self._journal({"action": "decode_start",
+                       "slots": self.dcfg.decode_slots,
+                       "block_size": self.dcfg.block_size,
+                       "num_blocks": self.dcfg.num_blocks,
+                       "max_prompt_len": self.dcfg.max_prompt_len,
+                       "max_new_tokens": self.dcfg.max_new_tokens,
+                       "swap_policy": self.dcfg.swap_policy,
+                       "model_step": self.model_step})

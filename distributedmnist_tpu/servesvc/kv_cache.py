@@ -1,0 +1,171 @@
+"""Paged KV cache for continuous-batching autoregressive decode.
+
+The decode service's memory manager: K/V for every in-flight sequence
+live in ONE pair of device arrays shaped ``[layers, num_blocks,
+block_size, heads, head_dim]``, carved into fixed-size blocks a
+free-list allocator hands out. Each sequence owns a **block table** —
+a fixed-width ``[max_blocks_per_seq]`` int32 map from its position
+range to blocks — so the compiled decode step reads any mix of
+sequence lengths through one gather, and finishing a 7-token sequence
+returns its blocks to the pool the same step a 90-token neighbor keeps
+generating. This is what lets wildly different lengths share a single
+compiled decode shape instead of bucket-padding rounds.
+
+Block 0 is the **reserved null block**: idle decode slots point their
+whole table (and their writes) at it, so the fixed-shape step never
+needs a branch — garbage lands in a block no sequence owns.
+
+Invariants the allocator maintains (property-tested in
+tests/test_kv_cache.py): a block is never assigned to two live
+sequences, alloc+free conserves the pool exactly, and reading a
+sequence back through its block table reproduces a dense reference
+cache byte-for-byte.
+
+Allocation policy: admission reserves EVERY block a sequence can need
+(prompt + max_new_tokens) up front, so an admitted sequence always
+runs to completion — block pressure defers admission (the request
+waits, bounded by its deadline), it never kills a running generation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NULL_BLOCK = 0
+
+
+class BlockAllocator:
+    """Free-list allocator over ``num_blocks`` fixed-size blocks.
+
+    Block 0 (:data:`NULL_BLOCK`) is reserved and never handed out.
+    ``alloc`` is all-or-nothing: a request the pool cannot satisfy
+    returns None and takes nothing (the caller defers admission)."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 blocks (one is the reserved null block), "
+                f"got {num_blocks}")
+        self.num_blocks = num_blocks
+        # LIFO free list: recently-freed blocks are re-used first
+        # (their cache lines are the warmest)
+        self._free: list[int] = list(range(num_blocks - 1, 0, -1))
+        self._in_use: set[int] = set()
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> frozenset[int]:
+        return frozenset(self._in_use)
+
+    def alloc(self, n: int) -> tuple[int, ...] | None:
+        """n blocks, or None (and no change) when the pool is short."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            return None
+        got = tuple(self._free.pop() for _ in range(n))
+        self._in_use.update(got)
+        return got
+
+    def free(self, blocks) -> None:
+        for b in blocks:
+            if b not in self._in_use:
+                raise ValueError(
+                    f"double free / foreign block {b} (in_use="
+                    f"{sorted(self._in_use)})")
+            self._in_use.remove(b)
+            self._free.append(b)
+
+
+def write_prompt_kv(k_cache: jax.Array, v_cache: jax.Array,
+                    ks: jax.Array, vs: jax.Array,
+                    block_table: jax.Array, length: jax.Array, *,
+                    block_size: int) -> tuple[jax.Array, jax.Array]:
+    """Scatter one sequence's prefill K/V into its blocks.
+
+    ``ks``/``vs`` [L, s_pad, h, hd] (the prefill export for ONE
+    sequence, padded to its prompt bucket); positions ``< length`` land
+    at ``block_table[pos // block_size]`` offset ``pos % block_size``,
+    padding positions are routed to the null block. jit this once per
+    prompt bucket shape."""
+    s_pad = ks.shape[1]
+    pos = jnp.arange(s_pad)
+    blk_ids = jnp.where(pos < length,
+                        block_table[pos // block_size], NULL_BLOCK)
+    offs = pos % block_size
+    k_cache = k_cache.at[:, blk_ids, offs].set(ks.astype(k_cache.dtype))
+    v_cache = v_cache.at[:, blk_ids, offs].set(vs.astype(v_cache.dtype))
+    return k_cache, v_cache
+
+
+class PagedKVCache:
+    """The device arrays + allocator + block-table bookkeeping.
+
+    ``k``/``v`` are functional jax arrays — every write goes through a
+    jitted scatter that returns the new arrays and is reassigned here
+    (single-writer: the decode loop thread)."""
+
+    def __init__(self, num_layers: int, num_blocks: int, block_size: int,
+                 num_heads: int, head_dim: int,
+                 max_blocks_per_seq: int, dtype=jnp.float32):
+        self.block_size = block_size
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.allocator = BlockAllocator(num_blocks)
+        shape = (num_layers, num_blocks, block_size, num_heads, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        import functools
+        # write_prompt's caller rebinds self.k/self.v to the outputs —
+        # donate the cache operands so the scatter updates in place
+        self._write = jax.jit(functools.partial(
+            write_prompt_kv, block_size=block_size),
+            donate_argnums=(0, 1))
+
+    def alloc_sequence(self, total_len: int) -> np.ndarray | None:
+        """Reserve blocks for a sequence of up to ``total_len`` tokens;
+        returns its fixed-width block table (padded with the null
+        block) or None under block pressure (nothing taken)."""
+        need = -(-total_len // self.block_size)
+        if need > self.max_blocks_per_seq:
+            raise ValueError(
+                f"{total_len} tokens need {need} blocks > "
+                f"max_blocks_per_seq={self.max_blocks_per_seq}")
+        got = self.allocator.alloc(need)
+        if got is None:
+            return None
+        table = np.full((self.max_blocks_per_seq,), NULL_BLOCK,
+                        dtype=np.int32)
+        table[:need] = got
+        return table
+
+    def free_sequence(self, block_table: np.ndarray) -> None:
+        self.allocator.free(int(b) for b in block_table
+                            if int(b) != NULL_BLOCK)
+
+    def write_prompt(self, block_table: np.ndarray, ks, vs,
+                     length: int) -> None:
+        """Install one sequence's prefill K/V (``ks``/``vs``
+        [L, s_pad, h, hd])."""
+        self.k, self.v = self._write(self.k, self.v, ks, vs,
+                                     jnp.asarray(block_table),
+                                     jnp.asarray(length))
+
+    def gather_dense(self, block_table: np.ndarray,
+                     length: int) -> tuple[np.ndarray, np.ndarray]:
+        """Read a sequence back as dense [L, length, h, hd] arrays —
+        the reference view the property tests compare against (host
+        path, not used by the decode step)."""
+        k = np.asarray(jax.device_get(self.k))
+        v = np.asarray(jax.device_get(self.v))
+        ks, vs = [], []
+        for pos in range(length):
+            b = int(block_table[pos // self.block_size])
+            o = pos % self.block_size
+            ks.append(k[:, b, o])
+            vs.append(v[:, b, o])
+        return np.stack(ks, axis=1), np.stack(vs, axis=1)

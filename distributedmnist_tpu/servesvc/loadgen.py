@@ -54,14 +54,39 @@ def make_input_fn(shape, dtype: str, vocab: int = 256
     return make
 
 
+def make_prompt_fn(vocab: int, max_prompt_len: int,
+                   min_prompt_len: int = 2) -> Callable[[int], list]:
+    """Deterministic per-request prompts for the decode service:
+    request ``i`` is always the same token list, with lengths spread
+    across [min, max] — the wildly-different-lengths mix the paged
+    cache exists to batch into one compiled shape."""
+    lo = max(1, min_prompt_len)
+    hi = max(lo, max_prompt_len)
+
+    def make(i: int) -> list:
+        rng = np.random.default_rng(i)
+        n = int(rng.integers(lo, hi + 1))
+        return rng.integers(0, vocab, size=(n,)).astype(int).tolist()
+
+    return make
+
+
 def run_load(client: ServeClient, num_requests: int | None,
              concurrency: int, make_input: Callable[[int], Any],
              journal_path: str | Path | None = None,
              stop_event: threading.Event | None = None,
-             deadline_s: float | None = None) -> dict[str, Any]:
+             deadline_s: float | None = None,
+             decode: bool = False) -> dict[str, Any]:
     """Drive the cluster closed-loop until ``num_requests`` terminal
     outcomes (or ``stop_event``, whichever first; one of the two must
-    be provided). Returns the summary; journals to ``journal_path``."""
+    be provided). Returns the summary; journals to ``journal_path``.
+
+    ``decode``: drive the generation path (``make_input`` yields token
+    prompts, requests go through :meth:`ServeClient.generate`) — the
+    outcome records then carry the two decode latency numbers
+    alongside e2e: ``ttft_ms`` (time-to-first-token) and ``itl_ms``
+    (mean per-token inter-arrival), and the summary aggregates their
+    p50/p99 plus total ``tokens_streamed``."""
     if num_requests is None and stop_event is None:
         raise ValueError("run_load needs num_requests or stop_event")
     sink = JsonlSink(journal_path) if journal_path is not None else None
@@ -89,8 +114,12 @@ def run_load(client: ServeClient, num_requests: int | None,
                 rid = next(counter)
             journal({"event": "load", "action": "issue", "id": rid,
                      "time": time.time()})
-            got = client.request(make_input(rid), request_id=rid,
-                                 deadline_s=deadline_s)
+            if decode:
+                got = client.generate(make_input(rid), request_id=rid,
+                                      deadline_s=deadline_s)
+            else:
+                got = client.request(make_input(rid), request_id=rid,
+                                     deadline_s=deadline_s)
             rec = {"event": "load", "action": "outcome", "id": rid,
                    "time": time.time(), "status": got.get("status"),
                    "reason": got.get("reason"),
@@ -101,6 +130,12 @@ def run_load(client: ServeClient, num_requests: int | None,
                    "attempts": got.get("attempts"),
                    "endpoint": got.get("endpoint"),
                    "latency_ms": got.get("latency_ms")}
+            if decode:
+                # decode latency is two numbers, not one: when the
+                # first token landed, and how fast they kept coming
+                rec["ttft_ms"] = got.get("ttft_ms")
+                rec["itl_ms"] = got.get("itl_ms")
+                rec["tokens"] = got.get("tokens_streamed")
             journal(rec)
             with out_lock:
                 outcomes.append(rec)
@@ -158,6 +193,27 @@ def summarize_outcomes(outcomes: list[dict], issued: int,
                              "p99": _percentile(lat, 0.99),
                              "max": lat[-1],
                              "mean": round(sum(lat) / len(lat), 3)}
+    # decode sweeps: the per-request two-number latency split — TTFT
+    # (prefill + queueing) and mean inter-token gap — aggregated only
+    # when the records carry them (classification records don't)
+    ttft = sorted(r["ttft_ms"] for r in ok
+                  if isinstance(r.get("ttft_ms"), (int, float)))
+    if ttft:
+        out["ttft_ms"] = {"p50": _percentile(ttft, 0.50),
+                          "p99": _percentile(ttft, 0.99),
+                          "max": ttft[-1],
+                          "mean": round(sum(ttft) / len(ttft), 3)}
+    itl = sorted(r["itl_ms"] for r in ok
+                 if isinstance(r.get("itl_ms"), (int, float)))
+    if itl:
+        out["inter_token_ms"] = {"p50": _percentile(itl, 0.50),
+                                 "p99": _percentile(itl, 0.99),
+                                 "max": itl[-1]}
+    tokens = sum(r["tokens"] for r in ok
+                 if isinstance(r.get("tokens"), int))
+    if tokens:
+        out["tokens_streamed"] = tokens
+        out["tokens_per_sec"] = round(tokens / max(duration_s, 1e-9), 2)
     return out
 
 

@@ -333,9 +333,12 @@ class ServingReplica:
         }, t0)
 
     def _install(self, staged: dict, t0: float,
-                 initial: bool = False) -> None:
+                 initial: bool = False,
+                 extra: dict | None = None) -> None:
         """Flip the staged weights in (batcher/boot thread only) and
-        journal the swap with its tier + source identity."""
+        journal the swap with its tier + source identity. ``extra``:
+        additional declared swap-record fields (the decode replica's
+        sequences_pinned / sequences_restarted bookkeeping)."""
         prev = self.model_step
         self._params = staged["params"]
         if staged["predict"] is not None:
@@ -355,7 +358,8 @@ class ServingReplica:
                "tier": staged["tier"],
                "source_artifact": staged["source_artifact"],
                "source_digest": staged["source_digest"],
-               "swap_ms": round((time.time() - t0) * 1e3, 3)}
+               "swap_ms": round((time.time() - t0) * 1e3, 3),
+               **(extra or {})}
         if initial:
             rec["initial"] = True
         self._journal(rec)
@@ -465,20 +469,9 @@ class ServingReplica:
             if self._stop.is_set():
                 self._reject(conn, req_id, "shutting_down", admitted=False)
                 return
-            try:
-                inputs = np.asarray(req["inputs"],
-                                    dtype=np.dtype(self.model.input_dtype))
-            except (KeyError, ValueError, TypeError):
-                self._reject(conn, req_id, "bad_request", admitted=False)
-                return
-            if tuple(inputs.shape) != tuple(self.model.input_shape):
-                self._reject(conn, req_id, "bad_request", admitted=False)
-                return
-            now = time.time()
-            deadline_ms = req.get("deadline_ms",
-                                  self.scfg.default_deadline_ms)
-            item = _Pending(req_id, inputs, conn, now,
-                            now + float(deadline_ms) / 1e3)
+            item = self._build_item(req, conn)
+            if item is None:
+                return  # _build_item already sent the typed reject
             try:
                 # admission control: a full queue sheds IMMEDIATELY
                 # with a typed reject — bounded queue, bounded latency,
@@ -488,7 +481,9 @@ class ServingReplica:
                 self._reject(conn, req_id, "overloaded", admitted=False)
                 return
             self._journal({"action": "admit", "id": req_id,
-                           "deadline_ms": float(deadline_ms)})
+                           "deadline_ms": round(
+                               (item.deadline_at - item.admitted_at)
+                               * 1e3, 3)})
         except OSError:
             # the socket died before we could even reject; if nothing
             # was admitted there is no outcome to owe
@@ -497,6 +492,27 @@ class ServingReplica:
                     conn.close()
                 except OSError:
                     pass
+
+    def _build_item(self, req: dict, conn) -> _Pending | None:
+        """Validate one request payload into a queue item, or send the
+        typed ``bad_request`` and return None. The workload-shaped half
+        of admission — the decode replica overrides it to parse
+        ``prompt`` requests instead of fixed-shape ``inputs``."""
+        req_id = req.get("id")
+        try:
+            inputs = np.asarray(req["inputs"],
+                                dtype=np.dtype(self.model.input_dtype))
+        except (KeyError, ValueError, TypeError):
+            self._reject(conn, req_id, "bad_request", admitted=False)
+            return None
+        if tuple(inputs.shape) != tuple(self.model.input_shape):
+            self._reject(conn, req_id, "bad_request", admitted=False)
+            return None
+        now = time.time()
+        deadline_ms = req.get("deadline_ms",
+                              self.scfg.default_deadline_ms)
+        return _Pending(req_id, inputs, conn, now,
+                        now + float(deadline_ms) / 1e3)
 
     def _accept_loop(self) -> None:
         assert self._sock is not None

@@ -1,0 +1,654 @@
+"""Continuous-batching decode service: sampling math, paged-decode ==
+full-context parity (dense and flash prefill), the DecodeReplica
+end-to-end over real sockets (streaming, refill, admission, graceful
+drain), deterministic swap-policy drives (pin / restart), and the
+decode_swap replay invariant over handcrafted journals."""
+
+import json
+import shutil
+import socket
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+LM_MODEL = {"name": "transformer", "seq_len": 64, "model_dim": 64,
+            "num_heads": 4, "num_layers": 2, "vocab_size": 32,
+            "compute_dtype": "float32", "attention_impl": "dense"}
+
+
+# ---------------------------------------------------------------------------
+# sampling (models/registry.sample_token)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.tier1
+def test_sample_token_greedy_is_argmax():
+    import jax.numpy as jnp
+
+    from distributedmnist_tpu.models.registry import sample_token
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(5, 32)).astype(np.float32))
+    got = sample_token(logits)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.argmax(np.asarray(logits), axis=-1))
+
+
+@pytest.mark.tier1
+def test_sample_token_temperature_to_zero_converges_to_greedy():
+    import jax
+    import jax.numpy as jnp
+
+    from distributedmnist_tpu.models.registry import sample_token
+
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(32,)).astype(np.float32))
+    greedy = int(np.argmax(np.asarray(logits)))
+    # tiny temperature: every key must sample the mode
+    for seed in range(8):
+        got = int(sample_token(logits, jax.random.PRNGKey(seed),
+                               temperature=1e-6))
+        assert got == greedy
+    # top_k=1 is greedy at any temperature
+    got = int(sample_token(logits, jax.random.PRNGKey(0),
+                           temperature=5.0, top_k=1))
+    assert got == greedy
+    # missing key is a loud error, not a silent greedy fallback
+    with pytest.raises(ValueError, match="PRNG key"):
+        sample_token(logits, temperature=1.0)
+
+
+@pytest.mark.tier1
+def test_sample_token_top_k_restricts_support():
+    import jax
+    import jax.numpy as jnp
+
+    from distributedmnist_tpu.models.registry import sample_token
+
+    rng = np.random.default_rng(2)
+    logits_np = rng.normal(size=(32,)).astype(np.float32)
+    logits = jnp.asarray(logits_np)
+    top3 = set(np.argsort(logits_np)[-3:].tolist())
+    for seed in range(24):
+        got = int(sample_token(logits, jax.random.PRNGKey(seed),
+                               temperature=2.0, top_k=3))
+        assert got in top3
+
+
+# ---------------------------------------------------------------------------
+# paged decode == full-context forward (the numerical core)
+# ---------------------------------------------------------------------------
+
+def _greedy_paged(model, params, prompt, n_new, *, block_size=8,
+                  num_blocks=32, slot=1, num_slots=3):
+    """Greedy-generate ``n_new`` tokens through the paged cache, using
+    a non-zero slot in a wider-than-needed slot shape (the fixed
+    compiled shape the replica runs)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributedmnist_tpu.servesvc.kv_cache import PagedKVCache
+
+    L, H, HD = model.decode_cache_shape
+    cache = PagedKVCache(L, num_blocks, block_size, H, HD,
+                         max_blocks_per_seq=16, dtype=jnp.float32)
+    plen = len(prompt)
+    bucket = 16
+    toks = np.zeros((1, bucket), np.int32)
+    toks[0, :plen] = prompt
+    logits, ks, vs = model.decode_prefill(params, jnp.asarray(toks))
+    table = cache.alloc_sequence(plen + n_new)
+    cache.write_prompt(table, ks[:, 0], vs[:, 0], plen)
+    step = jax.jit(functools.partial(model.decode_step,
+                                     block_size=block_size))
+    gen = [int(jnp.argmax(logits[0, plen - 1]))]
+    length = plen
+    width = cache.max_blocks_per_seq
+    for _ in range(n_new - 1):
+        tokens = np.zeros(num_slots, np.int32)
+        positions = np.zeros(num_slots, np.int32)
+        lengths = np.zeros(num_slots, np.int32)
+        tables = np.zeros((num_slots, width), np.int32)
+        tokens[slot] = gen[-1]
+        positions[slot] = length
+        lengths[slot] = length + 1
+        tables[slot] = table
+        lg, cache.k, cache.v = step(
+            params, jnp.asarray(tokens), jnp.asarray(positions),
+            cache.k, cache.v, jnp.asarray(tables), jnp.asarray(lengths))
+        length += 1
+        gen.append(int(jnp.argmax(lg[slot])))
+    return gen
+
+
+@pytest.mark.tier1
+def test_paged_decode_matches_full_context_greedy():
+    """Greedy decode through the paged cache reproduces the argmax of
+    the full-context forward token-for-token — the claim that one
+    compiled decode shape serves any sequence length correctly."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributedmnist_tpu.core.config import ModelConfig
+    from distributedmnist_tpu.models.registry import get_model
+
+    model = get_model(ModelConfig(**LM_MODEL))
+    params = model.init(jax.random.PRNGKey(0))
+    prompt = [3, 7, 1, 9, 2, 11, 4]
+    gen = _greedy_paged(model, params, prompt, 9)
+    ref_seq = list(prompt)
+    for _ in range(9):
+        full = model.apply(params,
+                           jnp.asarray(np.array(ref_seq, np.int32)[None]),
+                           train=False)
+        ref_seq.append(int(jnp.argmax(full[0, -1])))
+    assert gen == ref_seq[len(prompt):]
+
+
+@pytest.mark.tier1
+def test_prefill_logits_match_plain_apply_and_flash_kernel():
+    """The prefill export is the SAME forward as the training apply
+    (logits bitwise-close), through the dense path and the fused
+    pallas flash kernel alike — the prefill-reuses-the-flash-kernel
+    claim, pinned in interpret mode."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributedmnist_tpu.core.config import ModelConfig
+    from distributedmnist_tpu.models.registry import get_model
+
+    dense_cfg = ModelConfig(**LM_MODEL)
+    flash_cfg = dataclasses.replace(dense_cfg, attention_impl="flash")
+    dense = get_model(dense_cfg)
+    flash = get_model(flash_cfg)
+    params = dense.init(jax.random.PRNGKey(3))
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, 32, size=(2, 16))
+        .astype(np.int32))
+    ref = dense.apply(params, toks, train=False)
+    for model, tol in ((dense, 0.0), (flash, 2e-4)):
+        logits, ks, vs = model.decode_prefill(params, toks)
+        assert ks.shape == (2, 2, 16, 4, 16) and vs.shape == ks.shape
+        if tol == 0.0:
+            np.testing.assert_array_equal(np.asarray(logits),
+                                          np.asarray(ref))
+        else:
+            np.testing.assert_allclose(np.asarray(logits),
+                                       np.asarray(ref),
+                                       rtol=tol, atol=tol)
+
+
+@pytest.mark.tier1
+def test_decode_config_validation():
+    from distributedmnist_tpu.core.config import ConfigError, DecodeConfig
+
+    DecodeConfig().validate()
+    with pytest.raises(ConfigError, match="swap_policy"):
+        DecodeConfig(swap_policy="replay").validate()
+    with pytest.raises(ConfigError, match="num_blocks"):
+        DecodeConfig(num_blocks=4, max_prompt_len=64,
+                     max_new_tokens=64, block_size=8).validate()
+    assert DecodeConfig(block_size=16, max_prompt_len=64,
+                        max_new_tokens=33).max_blocks_per_seq() == 7
+
+
+# ---------------------------------------------------------------------------
+# shared LM publisher (one short deterministic training run per module)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lm_published(tmp_path_factory):
+    staging = tmp_path_factory.mktemp("lm_staging")
+    from distributedmnist_tpu.core.config import ExperimentConfig
+    cfg = ExperimentConfig.from_dict({
+        "data": {"dataset": "synthetic_lm", "batch_size": 32,
+                 "synthetic_train_size": 256, "synthetic_test_size": 64,
+                 "use_native_pipeline": False},
+        "model": dict(LM_MODEL),
+        "train": {"max_steps": 20, "log_every_steps": 10,
+                  "train_dir": str(staging),
+                  "save_interval_steps": 10, "save_results_period": 0,
+                  "async_checkpoint": False},
+    })
+    from distributedmnist_tpu.train.loop import Trainer
+    Trainer(cfg).run()
+    steps = sorted(int(p.name[5:13]) for p in staging.glob("ckpt-*.msgpack"))
+    assert steps == [10, 20]
+    return {"staging": staging, "cfg": cfg, "steps": steps}
+
+
+def publish_step(staging: Path, serve_dir: Path, step: int) -> None:
+    name = f"ckpt-{step:08d}.msgpack"
+    serve_dir.mkdir(parents=True, exist_ok=True)
+    for sfx in ("", ".sha256"):
+        shutil.copy2(staging / (name + sfx), serve_dir / (name + sfx))
+    tmp = serve_dir / "checkpoint.json.tmp"
+    tmp.write_text(json.dumps({"latest_step": step, "latest_path": name,
+                               "written_at": time.time()}))
+    tmp.replace(serve_dir / "checkpoint.json")
+
+
+def make_replica(lm_published, tmp_path, policy="pin", slots=3,
+                 max_new=10):
+    from distributedmnist_tpu.core.config import DecodeConfig, ServeConfig
+    from distributedmnist_tpu.servesvc.decode import DecodeReplica
+    serve_src = tmp_path / "publish"
+    publish_step(lm_published["staging"], serve_src, 10)
+    rep = DecodeReplica(
+        serve_src, serve_dir=tmp_path / "replica",
+        scfg=ServeConfig(poll_secs=0.05),
+        dcfg=DecodeConfig(decode_slots=slots, block_size=8,
+                          num_blocks=32, max_prompt_len=16,
+                          max_new_tokens=max_new, swap_policy=policy),
+        cfg=lm_published["cfg"])
+    return rep, serve_src
+
+
+def serve_records(rep) -> list[dict]:
+    return [json.loads(l) for l in
+            (rep.serve_dir / "serve_log.jsonl").read_text().splitlines()
+            if l.strip()]
+
+
+class StubConn:
+    """Direct-drive connection double: collects every streamed line."""
+
+    def __init__(self):
+        self.lines: list[dict] = []
+
+    def settimeout(self, t):
+        pass
+
+    def sendall(self, b):
+        for line in b.decode().splitlines():
+            self.lines.append(json.loads(line))
+
+    def close(self):
+        pass
+
+
+def admit_direct(rep, req: dict) -> object:
+    """Admit one request the way _handle_conn would (validation +
+    admit journal + queue), without a socket — what lets the swap
+    tests drive the decode loop deterministically."""
+    conn = StubConn()
+    seq = rep._build_item(req, conn)
+    assert seq is not None
+    rep._journal({"action": "admit", "id": seq.req_id,
+                  "deadline_ms": round(
+                      (seq.deadline_at - seq.admitted_at) * 1e3, 3)})
+    rep._queue.put_nowait(seq)
+    return seq, conn
+
+
+# ---------------------------------------------------------------------------
+# the replica end-to-end (real sockets, threads, streaming)
+# ---------------------------------------------------------------------------
+
+def test_decode_replica_streams_and_batches_end_to_end(lm_published,
+                                                       tmp_path):
+    from distributedmnist_tpu.servesvc.client import ServeClient
+    from distributedmnist_tpu.servesvc.loadgen import (make_prompt_fn,
+                                                       run_load)
+
+    rep, serve_src = make_replica(lm_published, tmp_path)
+    rep.start()
+    try:
+        client = ServeClient([("127.0.0.1", rep.bound_port)],
+                             deadline_s=30.0)
+        meta = client.meta()
+        assert meta["decode"] is True and meta["vocab_size"] == 32
+        assert meta["model_step"] == 10
+        streamed = []
+        out = client.generate([1, 2, 3, 4, 5], request_id=1,
+                              max_tokens=6,
+                              on_token=lambda r: streamed.append(
+                                  r.get("token")))
+        assert out["status"] == "ok", out
+        assert out["finish_reason"] == "max_tokens"
+        assert len(out["tokens"]) == 6 and streamed == out["tokens"]
+        assert out["ttft_ms"] is not None
+        # greedy determinism: the same prompt generates the same tokens
+        out2 = client.generate([1, 2, 3, 4, 5], request_id=2,
+                               max_tokens=6)
+        assert out2["tokens"] == out["tokens"]
+        # continuous batching: 3 slots, 12 concurrent requests of
+        # wildly different lengths — all complete, zero drops, and the
+        # loadgen summary carries the decode latency split
+        s = run_load(client, 12, 4, make_prompt_fn(32, 16),
+                     journal_path=tmp_path / "lg.jsonl", decode=True)
+        assert s["dropped"] == 0 and s["errors"] == 0, s
+        assert s["responses"] == 12
+        assert s["tokens_streamed"] > 12  # every response streamed
+        assert "ttft_ms" in s and "inter_token_ms" in s
+        assert s["tokens_per_sec"] > 0
+        recs = serve_records(rep)
+        fins = [r for r in recs if r["action"] == "decode_finish"]
+        assert len(fins) >= 14  # 2 singles + 12 loadgen
+        # more sequences finished than slots exist: slots turned over
+        assert len(fins) > rep.dcfg.decode_slots
+        admits = [r for r in recs if r["action"] == "admit"]
+        assert len(admits) == len(fins)  # exactly-one-terminal
+        # bad requests are typed, never crashes: too-long prompt,
+        # out-of-vocab token, missing prompt
+        for bad in ({"id": 90, "prompt": [1] * 99},
+                    {"id": 91, "prompt": [999]},
+                    {"id": 92, "inputs": [1, 2]}):
+            got = _raw_request(rep.bound_port, bad)
+            assert got["status"] == "rejected"
+            assert got["reason"] == "bad_request"
+    finally:
+        rep.stop()
+    # graceful stop: journal closed with serve_stop, no dangling admits
+    recs = serve_records(rep)
+    assert recs[-1]["action"] == "serve_stop"
+
+
+def _raw_request(port: int, payload: dict, timeout=10.0) -> dict:
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as conn:
+        conn.settimeout(timeout)
+        conn.sendall((json.dumps(payload) + "\n").encode())
+        buf = b""
+        while b"\n" not in buf:
+            chunk = conn.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    return json.loads(buf.decode().splitlines()[0])
+
+
+def test_decode_replica_sheds_typed_on_stop_mid_generation(lm_published,
+                                                           tmp_path):
+    """SIGTERM-equivalent stop with generations in flight: every
+    admitted request still reaches exactly one typed terminal."""
+    from distributedmnist_tpu.servesvc.client import ServeClient
+
+    rep, _ = make_replica(lm_published, tmp_path, slots=2, max_new=10)
+    rep.start()
+    outcomes = []
+
+    def gen(i):
+        client = ServeClient([("127.0.0.1", rep.bound_port)],
+                             deadline_s=10.0, max_attempts=1)
+        outcomes.append(client.generate([1, 2, 3], request_id=i,
+                                        max_tokens=10))
+
+    try:
+        threads = [threading.Thread(target=gen, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.25)  # let some get admitted / generating
+    finally:
+        rep.stop()
+    for t in threads:
+        t.join(timeout=15)
+    assert len(outcomes) == 4
+    # every client outcome is terminal (ok, a typed reject, or the
+    # client-side error after its bounded retry) — nothing hangs
+    assert all(o.get("status") in ("ok", "rejected", "error")
+               for o in outcomes), outcomes
+    recs = serve_records(rep)
+    admits = sum(1 for r in recs if r["action"] == "admit")
+    terminal = sum(1 for r in recs
+                   if r["action"] == "decode_finish"
+                   or (r["action"] == "reject" and r.get("admitted")))
+    assert admits == terminal  # server-side books balance
+
+
+# ---------------------------------------------------------------------------
+# swap-during-generation policies (deterministic direct drive)
+# ---------------------------------------------------------------------------
+
+def _drive_swap(lm_published, tmp_path, policy):
+    rep, serve_src = make_replica(lm_published, tmp_path, policy=policy,
+                                  slots=2, max_new=8)
+    rep._load_initial()
+    assert rep.model_step == 10
+    seq, conn = admit_direct(rep, {"id": 7, "prompt": [1, 2, 3],
+                                   "max_tokens": 8,
+                                   "deadline_ms": 60000})
+    rep._admit_new()
+    assert rep._slots[0] is seq and len(seq.tokens) == 1
+    rep._step_active()
+    publish_step(lm_published["staging"], serve_src, 20)
+    got = rep.follower.poll(rep._read_weights)
+    assert got is not None and got[0] == "swap"
+    rep._staged = got[1:]
+    rep._maybe_swap()
+    assert rep.model_step == 20
+    while rep._slots[0] is not None:
+        rep._step_active()
+    return rep, conn
+
+
+def test_swap_policy_pin_finishes_on_old_weights(lm_published, tmp_path):
+    rep, conn = _drive_swap(lm_published, tmp_path, "pin")
+    recs = serve_records(rep)
+    fin = next(r for r in recs if r["action"] == "decode_finish")
+    sw = next(r for r in recs if r["action"] == "weight_swap"
+              and not r.get("initial"))
+    assert fin["model_step"] == fin["started_step"] == 10
+    assert sw["sequences_pinned"] == 1
+    assert sw["sequences_restarted"] == 0
+    assert not any(r["action"] == "seq_restart" for r in recs)
+    # the pinned version was released the moment its sequence finished
+    assert not rep._versions
+    # a fresh admission runs on the NEW weights
+    seq2, conn2 = admit_direct(rep, {"id": 8, "prompt": [4, 5],
+                                     "max_tokens": 2,
+                                     "deadline_ms": 60000})
+    rep._admit_new()
+    while rep._slots[0] is not None:
+        rep._step_active()
+    assert conn2.lines[-1]["model_step"] == 20
+    # the invariant replays green over the real journal
+    assert _decode_swap_violations(rep, tmp_path / "pin_trial") == []
+
+
+def test_swap_policy_restart_reprefills_with_license(lm_published,
+                                                     tmp_path):
+    rep, conn = _drive_swap(lm_published, tmp_path, "restart")
+    recs = serve_records(rep)
+    fin = next(r for r in recs if r["action"] == "decode_finish")
+    sw = next(r for r in recs if r["action"] == "weight_swap"
+              and not r.get("initial"))
+    restart = next(r for r in recs if r["action"] == "seq_restart")
+    assert fin["model_step"] == 20 and fin["started_step"] == 10
+    assert fin["restarts"] == 1
+    assert sw["sequences_restarted"] == 1
+    assert restart["from_step"] == 10 and restart["to_step"] == 20
+    assert restart["tokens_discarded"] >= 1
+    # the stream told the client to reset before re-streaming
+    events = [l.get("stream") for l in conn.lines if "stream" in l]
+    assert "restart" in events
+    # the terminal carries the full regenerated sequence
+    final = conn.lines[-1]
+    assert final["status"] == "ok" and len(final["tokens"]) == 8
+    assert _decode_swap_violations(rep, tmp_path / "restart_trial") == []
+
+
+def _decode_swap_violations(rep, troot):
+    from distributedmnist_tpu.obsv.invariants import check_serving
+    (troot / "worker1").mkdir(parents=True)
+    shutil.copy2(rep.serve_dir / "serve_log.jsonl",
+                 troot / "worker1" / "serve_log.jsonl")
+    violations, applicable, _, decode_applicable = check_serving(
+        troot, {"serve_workers": [1]}, [])
+    assert applicable and decode_applicable
+    return [v.to_dict() for v in violations]
+
+
+# ---------------------------------------------------------------------------
+# the decode_swap invariant over handcrafted journals
+# ---------------------------------------------------------------------------
+
+def _decode_trial(tmp_path, records) -> Path:
+    trial = tmp_path / "trial"
+    (trial / "worker1").mkdir(parents=True)
+    (trial / "worker1" / "serve_log.jsonl").write_text(
+        "".join(json.dumps({"event": "serve", **r}) + "\n"
+                for r in records))
+    (trial / "worker1" / "train_log.jsonl").write_text("")
+    return trial
+
+
+def _swap_rec(step, t, **over):
+    return {"action": "weight_swap", "step": step, "from_step": step - 10,
+            "digest": "d", "tier": "fp32", "source_artifact": None,
+            "source_digest": "d", "swap_ms": 1.0, "time": t, **over}
+
+
+def _finish_rec(rid, model_step, started_step, t):
+    return {"action": "decode_finish", "id": rid, "reason": "max_tokens",
+            "tokens_streamed": 4, "model_step": model_step,
+            "started_step": started_step, "latency_ms": 5.0, "time": t}
+
+
+def _check(trial):
+    from distributedmnist_tpu.obsv.invariants import check_serving
+    violations, applicable, _, decode_applicable = check_serving(
+        trial, {"serve_workers": [1]}, [])
+    assert applicable
+    return decode_applicable, {v.invariant for v in violations}, violations
+
+
+@pytest.mark.tier1
+def test_decode_swap_invariant_clean_pin_and_restart(tmp_path):
+    # pin: every finish on its started step — green
+    dec, by_inv, _ = _check(_decode_trial(tmp_path / "a", [
+        _swap_rec(20, 100.0, sequences_pinned=1, sequences_restarted=0),
+        {"action": "admit", "id": 1, "deadline_ms": 100.0, "time": 100.1},
+        _finish_rec(1, 10, 10, 100.2),
+    ]))
+    assert dec and "decode_swap" not in by_inv
+    # restart: step changed WITH the seq_restart license — green
+    dec, by_inv, _ = _check(_decode_trial(tmp_path / "b", [
+        _swap_rec(20, 100.0, sequences_pinned=0, sequences_restarted=1),
+        {"action": "admit", "id": 1, "deadline_ms": 100.0, "time": 100.05},
+        {"action": "seq_restart", "id": 1, "from_step": 10,
+         "to_step": 20, "tokens_discarded": 2, "time": 100.1},
+        _finish_rec(1, 20, 10, 100.2),
+    ]))
+    assert dec and "decode_swap" not in by_inv
+
+
+@pytest.mark.tier1
+def test_decode_swap_invariant_catches_unlicensed_step_change(tmp_path):
+    dec, by_inv, v = _check(_decode_trial(tmp_path, [
+        _swap_rec(20, 100.0, sequences_pinned=0, sequences_restarted=0),
+        {"action": "admit", "id": 1, "deadline_ms": 100.0, "time": 100.1},
+        _finish_rec(1, 20, 10, 100.2),  # drifted, no license
+    ]))
+    assert dec and "decode_swap" in by_inv
+    assert "no live seq_restart license" in v[0].detail
+
+
+@pytest.mark.tier1
+def test_decode_swap_invariant_catches_restart_without_swap(tmp_path):
+    dec, by_inv, _ = _check(_decode_trial(tmp_path / "none", [
+        {"action": "admit", "id": 1, "deadline_ms": 100.0, "time": 100.0},
+        {"action": "seq_restart", "id": 1, "from_step": 10,
+         "to_step": 20, "tokens_discarded": 2, "time": 100.1},
+        _finish_rec(1, 20, 10, 100.2),
+    ]))
+    assert dec and "decode_swap" in by_inv
+    # ORDER matters: a swap journaled only AFTER the restart is not a
+    # license — the restart ran on weights nothing had installed yet
+    dec, by_inv, _ = _check(_decode_trial(tmp_path / "late", [
+        {"action": "admit", "id": 1, "deadline_ms": 100.0, "time": 100.0},
+        {"action": "seq_restart", "id": 1, "from_step": 10,
+         "to_step": 20, "tokens_discarded": 2, "time": 100.1},
+        _swap_rec(20, 100.15),
+        _finish_rec(1, 20, 10, 100.2),
+    ]))
+    assert "decode_swap" in by_inv
+
+
+@pytest.mark.tier1
+def test_decode_swap_license_is_consumed_per_generation(tmp_path):
+    """Request ids recycle across sweeps in one journal: a legitimate
+    restart in generation 1 must not launder a LATER generation's
+    unlicensed mixed-weights finish under the same id."""
+    dec, by_inv, _ = _check(_decode_trial(tmp_path, [
+        _swap_rec(20, 100.0, sequences_pinned=0, sequences_restarted=1),
+        {"action": "admit", "id": 1, "deadline_ms": 100.0, "time": 100.05},
+        {"action": "seq_restart", "id": 1, "from_step": 10,
+         "to_step": 20, "tokens_discarded": 2, "time": 100.1},
+        _finish_rec(1, 20, 10, 100.2),   # licensed — consumed here
+        {"action": "admit", "id": 1, "deadline_ms": 100.0, "time": 100.3},
+        _finish_rec(1, 30, 20, 100.4),   # drifted again, NO new license
+    ]))
+    assert dec and "decode_swap" in by_inv
+
+
+@pytest.mark.tier1
+def test_decode_swap_invariant_skipped_for_classification_trials(tmp_path):
+    dec, by_inv, _ = _check(_decode_trial(tmp_path, [
+        _swap_rec(20, 100.0),
+        {"action": "admit", "id": 1, "deadline_ms": 100.0, "time": 100.1},
+        {"action": "respond", "id": 1, "model_step": 20, "tier": "fp32",
+         "batch": 1, "bucket": 1, "latency_ms": 2.0, "time": 100.2},
+    ]))
+    assert not dec and "decode_swap" not in by_inv
+
+
+# ---------------------------------------------------------------------------
+# chaos decode-mode wiring + the acceptance trial
+# ---------------------------------------------------------------------------
+
+@pytest.mark.tier1
+def test_chaos_decode_payload_wiring():
+    from distributedmnist_tpu.launch.chaos import ChaosConfig
+    from distributedmnist_tpu.launch.cluster import ClusterError
+
+    cfg = ChaosConfig(payload="serving", serve_decode=True,
+                      serve_replicas=2)
+    cmd = cfg.resolved_train_command()
+    assert "model.name=transformer" in cmd
+    assert "data.dataset=synthetic_lm" in cmd
+    wc = cfg.resolved_worker_commands()
+    assert set(wc) == {"1", "2"}
+    assert all("--decode" in c for c in wc.values())
+    assert all("--max-new-tokens 16" in c for c in wc.values())
+    # prompt + generation must fit the compact LM's position table —
+    # the replica validates at boot, so the payload must pin both
+    assert all("--max-prompt-len 16" in c for c in wc.values())
+    # decode serves fp32 only: quant tiers refused at config build
+    with pytest.raises(ClusterError, match="fp32"):
+        ChaosConfig(payload="serving", serve_decode=True,
+                    serve_precision_tiers=("int8",))
+
+
+@pytest.mark.slow  # boots an LM publisher + 2 decode replicas + reference
+def test_decode_chaos_trial_end_to_end(tmp_path):
+    """The acceptance scenario: a seeded decode-mode serving trial —
+    replica killed mid-generation, published checkpoint torn, live
+    generate load throughout — completes with dropped == 0 and ALL
+    serving invariants (including decode_swap) passing."""
+    from distributedmnist_tpu.launch.chaos import ChaosConfig, run_campaign
+
+    cfg = ChaosConfig(
+        name="decodetrial", workdir=str(tmp_path), payload="serving",
+        serve_decode=True, trials=1, seed=0, until_step=60,
+        save_interval_steps=10, serve_replicas=2,
+        request_deadline_s=10.0, serve_fault_window=(3, 20),
+        shrink=False, trial_timeout_s=420.0)
+    summary = run_campaign(cfg)
+    assert summary["all_green"], summary
+    assert summary["faults"]["fired"] > 0, summary["faults"]
+    sv = summary["serving"]
+    assert sv["issued"] > 0 and sv["dropped"] == 0, sv
+    assert sv["tokens_streamed"] > 0
+    assert sv["ttft_p99_ms"] is not None
+    inv = summary["invariants"]
+    assert inv["decode_swap"]["fail"] == 0
+    assert (inv["decode_swap"]["pass"]
+            + inv["decode_swap"]["skipped"]) == 1

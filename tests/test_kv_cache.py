@@ -1,0 +1,107 @@
+"""Paged KV cache: allocator property tests (no double-assignment,
+pool conservation, all-or-nothing alloc) and the block-table-reads ==
+dense-reference-cache oracle the decode step's correctness rests on."""
+
+import numpy as np
+import pytest
+
+
+@pytest.mark.tier1
+def test_allocator_alloc_free_interleavings_property():
+    """Seeded random alloc/free interleavings: live allocations are
+    always disjoint, the free list conserves the pool exactly, the
+    null block is never handed out, and a failed alloc takes nothing."""
+    from distributedmnist_tpu.servesvc.kv_cache import (NULL_BLOCK,
+                                                       BlockAllocator)
+
+    rng = np.random.default_rng(0)
+    for trial in range(20):
+        num_blocks = int(rng.integers(2, 40))
+        alloc = BlockAllocator(num_blocks)
+        live: list[tuple[int, ...]] = []
+        for _ in range(200):
+            if live and rng.random() < 0.45:
+                got = live.pop(int(rng.integers(len(live))))
+                alloc.free(got)
+            else:
+                n = int(rng.integers(0, num_blocks))
+                got = alloc.alloc(n)
+                if got is None:
+                    # all-or-nothing: a refused alloc changed nothing
+                    assert n > alloc.available
+                    continue
+                assert len(got) == n
+                live.append(got)
+            flat = [b for blocks in live for b in blocks]
+            # never double-assigned, never the null block
+            assert len(flat) == len(set(flat))
+            assert NULL_BLOCK not in flat
+            # conservation: free + live == the allocatable pool
+            assert alloc.available + len(flat) == num_blocks - 1
+            assert alloc.in_use == set(flat)
+        for blocks in live:
+            alloc.free(blocks)
+        assert alloc.available == num_blocks - 1
+
+
+@pytest.mark.tier1
+def test_allocator_double_free_refused():
+    from distributedmnist_tpu.servesvc.kv_cache import BlockAllocator
+
+    alloc = BlockAllocator(8)
+    got = alloc.alloc(3)
+    alloc.free(got)
+    with pytest.raises(ValueError, match="double free"):
+        alloc.free(got[:1])
+
+
+@pytest.mark.tier1
+def test_block_table_reads_equal_dense_reference():
+    """Write three sequences of wildly different lengths through the
+    paged scatter, read each back through its block table — bytes must
+    equal a dense per-sequence reference cache."""
+    import jax.numpy as jnp
+
+    from distributedmnist_tpu.servesvc.kv_cache import PagedKVCache
+
+    L, H, HD, BS = 2, 3, 4, 4
+    cache = PagedKVCache(L, 32, BS, H, HD, max_blocks_per_seq=8,
+                         dtype=jnp.float32)
+    rng = np.random.default_rng(1)
+    seqs = []
+    for length in (3, 9, 17):  # straddles 1, 3 and 5 blocks
+        table = cache.alloc_sequence(length)
+        assert table is not None and table.shape == (8,)
+        s_pad = 32  # deliberately over-padded: padding must not leak
+        ks = rng.normal(size=(L, s_pad, H, HD)).astype(np.float32)
+        vs = rng.normal(size=(L, s_pad, H, HD)).astype(np.float32)
+        cache.write_prompt(table, ks, vs, length)
+        seqs.append((table, length, ks, vs))
+    for table, length, ks, vs in seqs:
+        got_k, got_v = cache.gather_dense(table, length)
+        np.testing.assert_array_equal(got_k, ks[:, :length])
+        np.testing.assert_array_equal(got_v, vs[:, :length])
+    # freeing one sequence leaves the others' bytes untouched
+    table0, *_ = seqs[0]
+    cache.free_sequence(table0)
+    for table, length, ks, vs in seqs[1:]:
+        got_k, _ = cache.gather_dense(table, length)
+        np.testing.assert_array_equal(got_k, ks[:, :length])
+
+
+@pytest.mark.tier1
+def test_alloc_sequence_block_pressure_and_free_cycle():
+    import jax.numpy as jnp
+
+    from distributedmnist_tpu.servesvc.kv_cache import PagedKVCache
+
+    cache = PagedKVCache(1, 8, 4, 1, 2, max_blocks_per_seq=4,
+                         dtype=jnp.float32)
+    t1 = cache.alloc_sequence(16)  # 4 blocks
+    t2 = cache.alloc_sequence(12)  # 3 blocks → pool exhausted (7 total)
+    assert t1 is not None and t2 is not None
+    assert cache.alloc_sequence(4) is None  # pressure: defer, not crash
+    cache.free_sequence(t1)
+    assert cache.alloc_sequence(4) is not None
+    with pytest.raises(ValueError, match="max_blocks_per_seq"):
+        cache.alloc_sequence(100)

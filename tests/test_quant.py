@@ -203,6 +203,27 @@ def test_fp32_artifact_byte_unchanged_by_quant_pass(quant_run):
     assert meta["source_params_digest"] == got[0]
 
 
+def test_quant_publish_idempotent_per_source_digest(quant_run):
+    """Re-publishing a step whose sidecar already records the SAME
+    source params digest is a skip, not a second pass — the final save
+    at max_steps re-triggers the cadence step's publish whenever the
+    async writer drained between the two enqueues, and the duplicate
+    must not pay the quantize work, rewrite bytes, or bump the
+    telemetry the tests gate on (the published==2 race this pins)."""
+    import hashlib
+
+    from distributedmnist_tpu.quant.ptq import QuantPublisher
+    from distributedmnist_tpu.train import checkpoint as ckpt
+    sidecar = quant_run["with"] / "ckpt-00000020.quant.msgpack"
+    before = hashlib.sha256(sidecar.read_bytes()).hexdigest()
+    state_sd, _ = ckpt._checkpoint_state_dict(quant_run["with"], 20)
+    pub = QuantPublisher(None, quant_run["cfg"], None, calib_inputs=None)
+    meta = pub.publish(quant_run["with"], ("full", state_sd), 20)
+    assert meta is not None  # the existing sidecar's meta, returned
+    assert pub.published == 0  # skipped — no second pass
+    assert hashlib.sha256(sidecar.read_bytes()).hexdigest() == before
+
+
 def test_cross_knob_restore_ignores_sidecars(quant_run, synthetic_datasets):
     """A dir full of sidecars restores into a quant-less config (and
     the restored step/params match) — the sidecar can never poison the
@@ -355,7 +376,7 @@ def test_serve_digest_invariant_matches_torn_artifact_by_name(tmp_path):
         journal = [{"event": "fault",
                     "action": "corrupt_latest_checkpoint",
                     "worker": 0, "target": torn, "ts": 100.0}]
-        violations, applicable, _ = check_serving(
+        violations, applicable, _, _ = check_serving(
             d, {"serve_workers": [1]}, journal)
         assert applicable
         return {v.invariant for v in violations}

@@ -531,10 +531,10 @@ def _check(trial) -> dict:
     from distributedmnist_tpu.obsv.invariants import check_serving
     from distributedmnist_tpu.obsv.report import load_jsonl
     journal = load_jsonl(trial / "command_journal.jsonl")
-    violations, applicable, workers = check_serving(
+    violations, applicable, workers, decode_applicable = check_serving(
         trial, {"serve_workers": [1]}, journal)
     return {"violations": violations, "applicable": applicable,
-            "workers": workers,
+            "workers": workers, "decode_applicable": decode_applicable,
             "by_inv": {v.invariant for v in violations}}
 
 
@@ -579,8 +579,10 @@ def test_serving_invariant_monotone(tmp_path):
 def test_serving_invariants_skip_for_train_trials(tmp_path):
     from distributedmnist_tpu.obsv.invariants import check_serving
     (tmp_path / "t").mkdir()
-    violations, applicable, workers = check_serving(tmp_path / "t", {}, [])
+    violations, applicable, workers, decode_applicable = check_serving(
+        tmp_path / "t", {}, [])
     assert not applicable and not violations and not workers
+    assert not decode_applicable
 
 
 # ---------------------------------------------------------------------------
