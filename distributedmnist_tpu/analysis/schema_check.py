@@ -53,6 +53,10 @@ _WRAPPERS: dict[str, tuple[str, str, tuple[str, ...], frozenset[str]]] = {
                       ("distributedmnist_tpu/launch/supervisor",),
                       frozenset({"event", "layer", "action", "time",
                                  "seed"})),
+    "_autoscale_event": ("autoscale", "action-arg",
+                         ("distributedmnist_tpu/launch/broker",),
+                         frozenset({"event", "layer", "action", "time",
+                                    "seed"})),
     # checkpoint-layer callbacks: the Trainer re-journals these as
     # event:"recovery" records (train/loop.py _recovery_event)
     "on_event": ("recovery", "payload", ("distributedmnist_tpu/",),

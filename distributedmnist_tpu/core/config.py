@@ -717,6 +717,109 @@ class EvalConfig:
     max_evals: int = 0  # 0 = unbounded
 
 
+@dataclass(frozen=True)
+class BrokerConfig:
+    """Resource broker (``launch/broker.py``) — demand-driven
+    autoscaling across one mixed trainer + serving roster.
+
+    The broker reads a rolling window of journaled pressure signals
+    (loadgen ``window`` snapshots, replica heartbeat queue/KV fields,
+    trainer step rate) and trades roster slots through the cluster's
+    existing reconfigure verb. Every threshold here is a PAIR — a high
+    water mark that licenses scale-up and a strictly lower low water
+    mark all signals must drop below before scale-down — because a
+    single threshold flaps: a signal hovering at the mark would grow
+    and shrink the roster on alternate polls. ``cooldown_s`` is the
+    second anti-flap guard: after any roster change the broker holds
+    its fire for that long no matter what the window says.
+
+    * ``p99_high_ms`` / ``p99_low_ms`` — serving p99 latency marks.
+    * ``reject_high`` / ``reject_low`` — overloaded-reject-rate marks
+      (fraction of terminal outcomes in the window).
+    * ``ttft_high_ms`` / ``ttft_low_ms`` — decode time-to-first-token
+      p99 marks (ignored for windows with no TTFT data).
+    * ``queue_high`` / ``queue_low`` — replica queue occupancy marks
+      as a fraction of the admission bound (``serve.queue_depth``).
+    * ``kv_free_low`` / ``kv_free_high`` — KV block-pool FREE fraction:
+      scale up when free blocks fall BELOW the low mark (pool pressure
+      defers admissions), scale down only once back above the high.
+    * ``min_serve_replicas`` / ``max_serve_replicas`` and
+      ``min_train_workers`` / ``max_train_workers`` — hard roster
+      bounds the broker never crosses, whatever the signals say.
+    * ``window_s`` — how much history a signal snapshot covers (also
+      the loadgen snapshot window).
+    * ``poll_secs`` — broker control-loop cadence.
+    * ``settle_timeout_s`` — how long a begun roster change may take to
+      report new capacity live before the broker journals an error.
+    """
+
+    poll_secs: float = 1.0
+    window_s: float = 10.0
+    cooldown_s: float = 15.0
+    p99_high_ms: float = 500.0
+    p99_low_ms: float = 150.0
+    reject_high: float = 0.05
+    reject_low: float = 0.005
+    ttft_high_ms: float = 500.0
+    ttft_low_ms: float = 150.0
+    queue_high: float = 0.8
+    queue_low: float = 0.2
+    kv_free_low: float = 0.10
+    kv_free_high: float = 0.50
+    min_serve_replicas: int = 1
+    max_serve_replicas: int = 3
+    min_train_workers: int = 1
+    max_train_workers: int = 8
+    settle_timeout_s: float = 60.0
+
+    def validate(self) -> None:
+        """Build-time validation (broker construction): a bad knob is
+        a typed ConfigError naming the constraint, not a roster that
+        flaps or a bound violated mid-campaign."""
+        for name, hi, lo in (("p99", self.p99_high_ms, self.p99_low_ms),
+                             ("reject", self.reject_high,
+                              self.reject_low),
+                             ("ttft", self.ttft_high_ms,
+                              self.ttft_low_ms),
+                             ("queue", self.queue_high,
+                              self.queue_low)):
+            if not hi > lo >= 0:
+                raise ConfigError(
+                    f"broker.{name} marks must satisfy high > low >= 0 "
+                    f"(hysteresis needs a dead band), got high={hi} "
+                    f"low={lo}")
+        if not 0 <= self.kv_free_low < self.kv_free_high <= 1:
+            raise ConfigError(
+                "broker.kv_free marks must satisfy 0 <= low < high "
+                f"<= 1, got low={self.kv_free_low} "
+                f"high={self.kv_free_high}")
+        if self.min_serve_replicas < 1:
+            raise ConfigError(
+                "broker.min_serve_replicas must be >= 1 (traffic must "
+                f"keep flowing), got {self.min_serve_replicas}")
+        if self.max_serve_replicas < self.min_serve_replicas:
+            raise ConfigError(
+                f"broker.max_serve_replicas={self.max_serve_replicas} "
+                f"< min_serve_replicas={self.min_serve_replicas}")
+        if self.min_train_workers < 1:
+            raise ConfigError(
+                "broker.min_train_workers must be >= 1, got "
+                f"{self.min_train_workers}")
+        if self.max_train_workers < self.min_train_workers:
+            raise ConfigError(
+                f"broker.max_train_workers={self.max_train_workers} "
+                f"< min_train_workers={self.min_train_workers}")
+        if self.poll_secs <= 0 or self.window_s <= 0:
+            raise ConfigError(
+                "broker.poll_secs and broker.window_s must be > 0, "
+                f"got {self.poll_secs}/{self.window_s}")
+        if self.cooldown_s < 0 or self.settle_timeout_s <= 0:
+            raise ConfigError(
+                "broker.cooldown_s must be >= 0 and "
+                "broker.settle_timeout_s > 0, got "
+                f"{self.cooldown_s}/{self.settle_timeout_s}")
+
+
 # Dtypes an activations/matmul override may name. The model section's
 # own compute_dtype predates this list and stays unvalidated here (its
 # consumers jnp.dtype() it at build); the OVERRIDE knobs
@@ -774,6 +877,7 @@ class ExperimentConfig:
     serve: ServeConfig = field(default_factory=ServeConfig)
     decode: DecodeConfig = field(default_factory=DecodeConfig)
     quant: QuantConfig = field(default_factory=QuantConfig)
+    broker: BrokerConfig = field(default_factory=BrokerConfig)
 
     # ---- construction helpers -------------------------------------------------
 
@@ -852,6 +956,7 @@ _SECTION_TYPES = {
     ("ExperimentConfig", "serve"): ServeConfig,
     ("ExperimentConfig", "decode"): DecodeConfig,
     ("ExperimentConfig", "quant"): QuantConfig,
+    ("ExperimentConfig", "broker"): BrokerConfig,
 }
 
 

@@ -75,6 +75,32 @@ def _park_standby(trainer, activation: str) -> None:
     trainer.adopt_train_dir(assignment["train_dir"])
 
 
+def _park_serve_standby(activation: str) -> str:
+    """The serving-payload half of the warm-standby protocol: the
+    parked spare has already paid process boot, jax import and the
+    publish-dir config wait (everything before this call in
+    ``_serve``); it signals ready, parks, and on promotion returns the
+    assigned worker logdir to use as serve_dir — the replica then
+    binds there and writes its endpoint card where
+    ``discover_endpoints`` looks. The assignment's ``train_dir`` key
+    names the ADOPTED logdir (the protocol's field name, shared with
+    the trainer's parking path)."""
+    import json as _json
+    import os as _os
+    import time as _time
+    from pathlib import Path
+
+    act = Path(activation)
+    act.parent.mkdir(parents=True, exist_ok=True)
+    ready = act.with_name(act.name + ".ready")
+    ready.write_text(_json.dumps({"pid": _os.getpid(),
+                                  "ready_at": _time.time()}))
+    while not act.exists():
+        _time.sleep(0.1)
+    assignment = _json.loads(act.read_text())
+    return assignment["train_dir"]
+
+
 def _train(args) -> None:
     import os
 
@@ -118,12 +144,21 @@ def _serve(args) -> None:
     the model/config from the checkpoint itself like the evaluator.
     ``--decode`` swaps the workload inside the replica contract from
     one-shot classification to continuous-batching autoregressive
-    decode (streaming tokens, paged KV cache)."""
+    decode (streaming tokens, paged KV cache).
+
+    Honors ``DMT_STANDBY_ACTIVATION`` like ``launch train``: a serving
+    spare pays the import + config wait up front, parks ready, and on
+    promotion adopts the ASSIGNED worker logdir as its serve_dir — the
+    warm pool the resource broker promotes scale-up replicas from."""
     import dataclasses
+    import os
 
     from ..servesvc.server import ServingReplica, wait_for_run_config
 
     cfg = wait_for_run_config(args.train_dir)
+    activation = os.environ.get("DMT_STANDBY_ACTIVATION")
+    if activation:
+        args.serve_dir = _park_serve_standby(activation)
     overrides = {k: getattr(args, k) for k in
                  ("host", "port", "max_batch", "queue_depth",
                   "batch_window_ms", "poll_secs", "default_deadline_ms",

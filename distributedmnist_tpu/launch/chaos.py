@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import random
 import shutil
 import time
 from pathlib import Path
@@ -453,6 +454,27 @@ class ChaosConfig:
     decode_max_new_tokens: int = 16
     decode_max_prompt_len: int = 16
     decode_slots: int = 4
+    # -- resource broker (serving mode only) ------------------------------
+    # broker=true arms demand-driven autoscaling (launch/broker.py)
+    # over the trial's roster: DONOR train workers join it
+    # (broker_train_workers TOTAL trainers incl. the publisher — the
+    # capacity the broker trades into serving slots, never the
+    # publisher itself), the load generator drives a seeded bursty
+    # diurnal trace (trough/peak concurrency phases with jittered
+    # durations) with rolling-window pressure snapshots journaled, and
+    # the ResourceBroker rides supervise_until_step's per-tick
+    # callback. Every roster change must replay against the
+    # "autoscale" invariant — the campaign's gate is at least one
+    # scale-up AND one scale-back with dropped==0 throughout.
+    broker: bool = False
+    broker_train_workers: int = 2   # total trainers incl. the publisher
+    broker_standbys: int = 0        # warm serving spares for scale-up
+    broker_phases: int = 4          # diurnal phases; odd = trough first
+    broker_low_concurrency: int = 1
+    broker_high_concurrency: int = 8
+    broker_phase_secs: float = 10.0
+    broker_window_s: float = 3.0    # loadgen rolling-window width
+    broker_config: dict | None = None  # BrokerConfig field overrides
     # schedule intensity
     max_faults: int = 3
     min_faults: int = 1
@@ -516,10 +538,41 @@ class ChaosConfig:
                 "serve_precision_tiers: the decode service serves "
                 "full precision only (quant sidecars hold weights for "
                 "the one-shot predict export)")
+        if self.broker:
+            # the broker recognizes serving slots by command EQUALITY
+            # with one uniform serving payload — a mixed-tier roster
+            # (per-replica command suffixes) would misclassify every
+            # non-fp32 replica as a trainer
+            if self.payload != "serving":
+                raise ClusterError(
+                    "broker=true requires payload=serving: the broker "
+                    "trades training slots for serving replicas")
+            if any(t and t != "fp32"
+                   for t in (self.serve_precision_tiers or ())):
+                raise ClusterError(
+                    "broker=true is incompatible with non-fp32 "
+                    "serve_precision_tiers: the broker identifies "
+                    "serving slots by payload equality, so the roster "
+                    "must run one uniform serving command")
+            if self.broker_train_workers < 2:
+                raise ClusterError(
+                    "broker=true requires broker_train_workers >= 2: "
+                    "the publisher is never a scale-up victim, so at "
+                    "least one donor trainer must exist for the broker "
+                    "to trade")
 
     @classmethod
-    def from_file(cls, path: str | Path) -> "ChaosConfig":
-        d = json.loads(Path(path).read_text())
+    def from_file(cls, path: str | Path,
+                  overrides: dict | None = None) -> "ChaosConfig":
+        # `--chaos-config` accepts a file path or inline JSON — a path
+        # can't start with "{", so the sniff is unambiguous. CLI flag
+        # overrides merge BEFORE construction: __post_init__ validates
+        # cross-field constraints (broker requires payload=serving), so
+        # the config must be built once, already merged.
+        text = str(path)
+        d = (json.loads(text) if text.lstrip().startswith("{")
+             else json.loads(Path(path).read_text()))
+        d.update(overrides or {})
         unknown = set(d) - {f.name for f in dataclasses.fields(cls)}
         if unknown:
             raise ClusterError(f"unknown chaos config keys: {sorted(unknown)}")
@@ -629,31 +682,62 @@ class ChaosConfig:
                 tiers.append(t)
         return tuple(tiers)
 
-    def resolved_worker_commands(self) -> dict[str, str]:
+    def resolved_serve_command(self) -> str:
+        """The uniform serving payload (fp32, no tier suffix) — the
+        broker's serving-slot identity and the command every replica
+        and warm standby runs under broker=true."""
+        cmd = _SERVE_PAYLOAD.format(queue=self.serve_queue_depth)
+        if self.serve_decode:
+            cmd += (f" --decode --decode-slots {self.decode_slots}"
+                    f" --max-new-tokens {self.decode_max_new_tokens}"
+                    f" --max-prompt-len {self.decode_max_prompt_len}")
+        return cmd
+
+    def resolved_donor_command(self,
+                               measured_boot_s: float | None = None
+                               ) -> str:
+        """A donor trainer's payload: the publisher's command with a
+        10× step budget so donors never finish inside the trial window
+        (the broker reaps them by reshape, not the supervisor by
+        restart). Safe for determinism — the LR schedule is an
+        epoch-indexed staircase, independent of max_steps."""
+        base = self.resolved_train_command(measured_boot_s)
+        return base.replace(f"train.max_steps={self.until_step}",
+                            f"train.max_steps={self.until_step * 10}")
+
+    def resolved_worker_commands(self,
+                                 measured_boot_s: float | None = None
+                                 ) -> dict[str, str]:
         """Per-worker payload overrides — serving mode's mixed roster
         (publisher + replicas); empty for the uniform payloads.
         ``serve_precision_tiers`` entry i pins replica i+1's tier (a
         mixed fp32/int8 roster exercises both weight paths under one
-        fault plan)."""
+        fault plan). Under broker=true the roster also carries donor
+        trainers after the replicas — overridden slots the broker may
+        trade for serving capacity."""
         if self.payload != "serving":
             return {}
         tiers = self.serve_precision_tiers or ()
         out: dict[str, str] = {}
-        for k in range(1, self.trial_num_workers()):
-            cmd = _SERVE_PAYLOAD.format(queue=self.serve_queue_depth)
-            if self.serve_decode:
-                cmd += (f" --decode --decode-slots {self.decode_slots}"
-                        f" --max-new-tokens {self.decode_max_new_tokens}"
-                        f" --max-prompt-len {self.decode_max_prompt_len}")
+        for k in range(1, 1 + self.serve_replicas):
+            cmd = self.resolved_serve_command()
             tier = tiers[k - 1] if k - 1 < len(tiers) else ""
             if tier and tier != "fp32":
                 cmd += f" --precision-tier {tier}"
             out[str(k)] = cmd
+        if self.broker:
+            donor = self.resolved_donor_command(measured_boot_s)
+            for k in range(1 + self.serve_replicas,
+                           self.trial_num_workers()):
+                out[str(k)] = donor
         return out
 
     def trial_num_workers(self) -> int:
-        return (1 + self.serve_replicas if self.payload == "serving"
-                else self.num_workers)
+        if self.payload != "serving":
+            return self.num_workers
+        donors = max(0, self.broker_train_workers - 1) if self.broker \
+            else 0
+        return 1 + self.serve_replicas + donors
 
     def step_window(self) -> tuple[int, int]:
         lo = max(2, self.save_interval_steps + 1)
@@ -662,6 +746,47 @@ class ChaosConfig:
     @property
     def root(self) -> Path:
         return Path(self.workdir) / self.name
+
+
+def _merge_load_summaries(summaries: list[dict | None]) -> dict | None:
+    """Fold the per-phase ``summarize_outcomes`` dicts of a diurnal
+    load trace into one trial-level summary: counters SUM, tail
+    latencies take the worst phase (the bound the chaos gate checks —
+    a per-request-weighted percentile across phases would launder a
+    bad burst through a long calm trough), serving evidence sets
+    union. Phases that never ran (``None``) are skipped; all-``None``
+    merges to ``None``."""
+    real = [s for s in summaries if s]
+    if not real:
+        return None
+    counters = ("issued", "terminal", "dropped", "responses",
+                "rejected", "errors", "tokens_streamed")
+    out: dict[str, Any] = {k: sum(int(s.get(k, 0)) for s in real)
+                           for k in counters}
+    if not out["tokens_streamed"]:
+        del out["tokens_streamed"]
+    by_reason: dict[str, int] = {}
+    for s in real:
+        for k, v in (s.get("by_reason") or {}).items():
+            by_reason[k] = by_reason.get(k, 0) + int(v)
+    out["by_reason"] = by_reason
+    out["reject_rate"] = round(out["rejected"] / max(1, out["terminal"]),
+                               4)
+    out["duration_s"] = round(sum(float(s.get("duration_s", 0.0))
+                                  for s in real), 3)
+    out["throughput_rps"] = round(
+        out["terminal"] / max(out["duration_s"], 1e-9), 2)
+    out["model_steps_served"] = sorted(
+        {st for s in real for st in s.get("model_steps_served", ())})
+    out["tiers_served"] = sorted(
+        {t for s in real for t in s.get("tiers_served", ())})
+    for key in ("latency_ms", "ttft_ms", "inter_token_ms"):
+        dists = [s[key] for s in real if s.get(key)]
+        if dists:
+            out[key] = {q: max(d[q] for d in dists if q in d)
+                        for q in dists[0]}
+    out["phases_merged"] = len(real)
+    return out
 
 
 class ChaosCampaign:
@@ -696,11 +821,19 @@ class ChaosCampaign:
         step is its request counter, not run progress)."""
         cfg = self.cfg
         target = cfg.until_step
+        broker = None
+        brokered = serving and cfg.broker
         lcfg = LocalClusterConfig(
             name=rel, num_workers=num_workers, workdir=str(cfg.root),
             train_command=cfg.resolved_train_command(measured_boot_s),
-            worker_commands=(cfg.resolved_worker_commands()
+            worker_commands=(cfg.resolved_worker_commands(measured_boot_s)
                              if serving else {}),
+            # brokered rosters park their warm spares on the SERVING
+            # payload: a scale-up promotes one into the new slot with
+            # its jax boot already paid
+            standby_command=(cfg.resolved_serve_command()
+                             if brokered and cfg.broker_standbys > 0
+                             else ""),
             # ONE cache for the whole campaign, not per-trial: the
             # reference's cold compile warms every later boot
             compile_cache=cfg.share_compile_cache,
@@ -719,6 +852,14 @@ class ChaosCampaign:
             standby_workers=cfg.standby_workers,
             seed=seed)
         sup = ClusterSupervisor(cluster, scfg)
+        if brokered:
+            from ..core.config import BrokerConfig
+            from .broker import ResourceBroker
+            bcfg = BrokerConfig(**(cfg.broker_config or {}))
+            broker = ResourceBroker(
+                sup, bcfg, serve_command=cfg.resolved_serve_command(),
+                loadgen_journal=lcfg.root / "loadgen.jsonl",
+                warm_standbys=cfg.broker_standbys)
         outcome: dict[str, Any] = {
             "name": rel, "seed": seed, "target": target,
             "num_workers": num_workers,
@@ -738,13 +879,16 @@ class ChaosCampaign:
             # already-spawned detached workers outlive the campaign
             cluster.create()
             cluster.run_train()
+            if broker is not None:
+                broker.start()  # provision the warm serving spares
             if serving:
                 loadgen_thread, load_stop = self._start_loadgen(
                     lcfg, load_result)
             got = sup.supervise_until_step(
                 target, poll_secs=cfg.resolved_poll_secs(),
                 timeout_secs=cfg.trial_timeout_s,
-                target_worker=0 if serving else None)
+                target_worker=0 if serving else None,
+                on_tick=broker.tick if broker is not None else None)
             outcome.update(outcome="completed", step=got["step"])
             if serving:
                 self._stop_serving(cluster, sup, num_workers,
@@ -779,8 +923,22 @@ class ChaosCampaign:
             executor.close()
         if serving:
             outcome["mode"] = "serving"
-            outcome["serve_workers"] = list(range(1, num_workers))
+            if brokered:
+                # the roster TRADED slots mid-run: the serving workers
+                # are whichever dirs actually served (grown ids
+                # included), not the boot-time range — the serving
+                # invariants replay exactly these journals
+                outcome["broker"] = True
+                outcome["autoscale"] = (broker.summary()
+                                        if broker is not None else None)
+                outcome["serve_workers"] = sorted(
+                    int(p.parent.name[len("worker"):])
+                    for p in lcfg.root.glob("worker*/serve_log.jsonl"))
+            else:
+                outcome["serve_workers"] = list(range(1, num_workers))
             outcome["serving"] = load_result.get("summary")
+            if load_result.get("phases") is not None:
+                outcome["load_phases"] = load_result["phases"]
             # weight-swap-by-tier accounting over every replica's
             # serve journal (tier-less legacy swaps count as fp32) —
             # the evidence a quantized campaign arm actually served
@@ -788,7 +946,7 @@ class ChaosCampaign:
             from ..obsv.journal import summarize_serving_swaps
             from ..obsv.report import load_jsonl
             serve_recs: list[dict] = []
-            for k in range(1, num_workers):
+            for k in outcome["serve_workers"]:
                 serve_recs += load_jsonl(
                     lcfg.worker_dir(k) / "serve_log.jsonl", "serve")
             outcome["serve_swaps"] = summarize_serving_swaps(serve_recs)
@@ -838,10 +996,63 @@ class ChaosCampaign:
             else:
                 make_input = make_input_fn(meta["input_shape"],
                                            meta["input_dtype"])
-            load_result["summary"] = run_load(
-                client, None, cfg.load_concurrency, make_input,
-                journal_path=root / "loadgen.jsonl", stop_event=stop,
-                decode=bool(meta.get("decode")))
+            decode = bool(meta.get("decode"))
+            if not cfg.broker:
+                load_result["summary"] = run_load(
+                    client, None, cfg.load_concurrency, make_input,
+                    journal_path=root / "loadgen.jsonl", stop_event=stop,
+                    decode=decode)
+                return
+            # broker mode: a seeded bursty DIURNAL trace — trough and
+            # peak concurrency phases with jittered durations, each a
+            # run_load leg appending to the one shared loadgen.jsonl
+            # with rolling-window pressure snapshots the broker reads.
+            # A final trough leg holds until the trial ends so the
+            # window stays fresh — the calm evidence the scale-back
+            # needs.
+            rng = random.Random(f"{cfg.seed}:{lcfg.name}:diurnal")
+            snap = max(0.5, cfg.broker_window_s / 3.0)
+            phases: list[dict[str, Any]] = []
+
+            def leg(conc: int, phase_stop) -> dict[str, Any] | None:
+                return run_load(
+                    client, None, conc, make_input,
+                    journal_path=root / "loadgen.jsonl",
+                    stop_event=phase_stop, decode=decode,
+                    window_s=cfg.broker_window_s, snapshot_every_s=snap)
+
+            for i in range(max(0, cfg.broker_phases)):
+                if stop.is_set():
+                    break
+                conc = (cfg.broker_low_concurrency if i % 2 == 0
+                        else cfg.broker_high_concurrency)
+                dur = cfg.broker_phase_secs * (0.8 + 0.4 * rng.random())
+                phase_stop = threading.Event()
+
+                def pace(deadline=time.monotonic() + dur, ps=phase_stop):
+                    while time.monotonic() < deadline \
+                            and not stop.is_set():
+                        time.sleep(0.1)
+                    ps.set()
+
+                pacer = threading.Thread(target=pace, daemon=True,
+                                         name=f"chaos-load-pace{i}")
+                pacer.start()
+                s = leg(conc, phase_stop)
+                pacer.join(timeout=5)
+                phases.append({"phase": i, "concurrency": conc,
+                               "duration_s": round(dur, 3),
+                               "summary": s})
+            if not stop.is_set():
+                s = leg(cfg.broker_low_concurrency, stop)
+                phases.append({"phase": len(phases),
+                               "concurrency": cfg.broker_low_concurrency,
+                               "duration_s": None, "summary": s})
+            load_result["summary"] = _merge_load_summaries(
+                [p["summary"] for p in phases])
+            load_result["phases"] = [
+                {k: v for k, v in p.items() if k != "summary"}
+                for p in phases]
 
         t = threading.Thread(target=drive, daemon=True, name="chaos-load")
         t.start()
@@ -865,8 +1076,15 @@ class ChaosCampaign:
                         w, events=("step", "heartbeat"))
                     if resumed is not None:
                         sup.close_episode(w["worker"], *resumed)
-        for k in range(1, num_workers):
-            cluster.stop_all(worker=str(k))
+        # stop whatever the roster holds NOW (a brokered trial's ids
+        # grow past the boot-time range), never worker 0 — the
+        # publisher already finished and its final save must not race
+        # a SIGTERM
+        live = (sorted(w["worker"] for w in st["workers"])
+                if st is not None else list(range(num_workers)))
+        for k in live:
+            if k != 0:
+                cluster.stop_all(worker=str(k))
         cluster.wait_drained(15.0)
 
     # spawn-observation helpers: the logic moved to launch/cluster.py
@@ -991,9 +1209,18 @@ class ChaosCampaign:
         serving = cfg.payload == "serving"
         nw = cfg.trial_num_workers()
         for t in range(cfg.trials):
-            if serving:
+            if serving and cfg.broker and cfg.max_faults == 0:
+                # broker-only campaign: the load trace IS the chaos —
+                # a fault-free schedule isolates the autoscale path
+                # (the gate: roster changes licensed, dropped==0)
+                schedule = ChaosSchedule(seed=cfg.seed, trial=t,
+                                         faults=())
+            elif serving:
+                # faults target the BOOT-TIME replicas only: a donor
+                # trainer's slot may be traded away mid-run, and a
+                # fault addressed to a dead id would no-op silently
                 schedule = generate_serving_schedule(
-                    cfg.seed, t, list(range(1, nw)),
+                    cfg.seed, t, list(range(1, 1 + cfg.serve_replicas)),
                     cfg.serve_fault_window, cfg.step_window(),
                     max_faults=cfg.max_faults, min_faults=cfg.min_faults,
                     stall_ms_range=cfg.resolved_stall_ms_range())
@@ -1049,6 +1276,9 @@ class ChaosCampaign:
                    "serve_swaps": outcome.get("serve_swaps"),
                    "verdicts": check["verdicts"],
                    "violations": check["violations"]}
+            if outcome.get("broker"):
+                rec["broker"] = True
+                rec["autoscale"] = outcome.get("autoscale")
             if check["violations"] and cfg.shrink and reproducer is None:
                 shrunk = self._shrink(t, schedule, check)
                 rec["shrunk"] = shrunk

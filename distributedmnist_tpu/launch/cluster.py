@@ -1268,7 +1268,7 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("action",
                    choices=["create", "delete", "status", "run", "kill-all",
                             "exec", "download", "poll", "supervise",
-                            "reconfigure", "chaos"])
+                            "reconfigure", "broker", "chaos"])
     p.add_argument("--backend", default="local", choices=["local", "gcloud"])
     p.add_argument("--config", default=None,
                    help="LocalClusterConfig / PodConfig JSON")
@@ -1350,6 +1350,22 @@ def main(argv: list[str] | None = None) -> None:
                         "override it)")
     p.add_argument("--no-shrink", action="store_true",
                    help="for chaos: skip minimizing failing schedules")
+    p.add_argument("--serve-command", default=None,
+                   help="for broker: the serving payload a scaled-up "
+                        "replica slot runs — also how the broker "
+                        "recognizes which roster slots are serving "
+                        "(command equality)")
+    p.add_argument("--broker-config", default=None,
+                   help="for broker: BrokerConfig JSON (thresholds, "
+                        "hysteresis marks, cooldown, roster bounds)")
+    p.add_argument("--loadgen-journal", default=None,
+                   help="for broker: the loadgen.jsonl carrying "
+                        "rolling-window pressure snapshots (defaults "
+                        "to <workdir>/loadgen.jsonl)")
+    p.add_argument("--warm-standbys", type=int, default=0,
+                   help="for broker: pre-boot N parked serving spares; "
+                        "a scale-up promotes one instead of paying a "
+                        "cold jax boot")
     args = p.parse_args(argv)
     poll_secs = 5.0 if args.poll_secs is None else args.poll_secs
 
@@ -1368,8 +1384,6 @@ def main(argv: list[str] | None = None) -> None:
                         "local clusters with seed-generated fault plans "
                         "(use --chaos-config)")
         from .chaos import ChaosConfig, run_campaign
-        ccfg = (ChaosConfig.from_file(args.chaos_config)
-                if args.chaos_config else ChaosConfig())
         overrides = {"trials": args.trials, "seed": args.seed,
                      "until_step": args.until_step,
                      "payload": args.payload,
@@ -1381,10 +1395,13 @@ def main(argv: list[str] | None = None) -> None:
                      "stall_timeout_s": args.stall_timeout_s,
                      "standby_workers": args.standby_workers,
                      "poll_secs": args.poll_secs}
-        ccfg = dataclasses.replace(
-            ccfg, **{k: v for k, v in overrides.items() if v is not None})
+        overrides = {k: v for k, v in overrides.items() if v is not None}
         if args.no_shrink:
-            ccfg = dataclasses.replace(ccfg, shrink=False)
+            overrides["shrink"] = False
+        # merged before construction — __post_init__ validates
+        # cross-field constraints, so flags can't land via replace()
+        ccfg = (ChaosConfig.from_file(args.chaos_config, overrides=overrides)
+                if args.chaos_config else ChaosConfig(**overrides))
         print(json.dumps(run_campaign(ccfg), default=str))
         return
 
@@ -1414,10 +1431,14 @@ def main(argv: list[str] | None = None) -> None:
                 timeout_secs=args.poll_timeout_s)))
         else:
             backend.run_train()
-    elif args.action in ("supervise", "reconfigure"):
+    elif args.action in ("supervise", "reconfigure", "broker"):
         from .supervisor import ClusterSupervisor, SupervisorConfig
-        if args.action == "supervise" and args.until_step is None:
-            p.error("supervise requires --until-step")
+        if args.action in ("supervise", "broker") \
+                and args.until_step is None:
+            p.error(f"{args.action} requires --until-step")
+        if args.action == "broker" and not args.serve_command:
+            p.error("broker requires --serve-command (the serving "
+                    "payload a scaled-up slot runs)")
         if args.action == "reconfigure" and args.new_workers is None:
             p.error("reconfigure requires --new-workers")
         scfg = (SupervisorConfig.from_file(args.supervisor_config)
@@ -1449,6 +1470,32 @@ def main(argv: list[str] | None = None) -> None:
             else:
                 print(json.dumps({"reconfigure": rec,
                                   "summary": sup.summary()}))
+        elif args.action == "broker":
+            # supervise + demand-driven autoscaling: the broker rides
+            # the supervise loop's per-tick callback, trading roster
+            # slots on journaled load pressure (every move replayable
+            # via the `autoscale` invariant)
+            from ..core.config import BrokerConfig
+            from .broker import ResourceBroker
+            bcfg = (BrokerConfig(**json.loads(
+                        Path(args.broker_config).read_text()))
+                    if args.broker_config else BrokerConfig())
+            journal_path = (Path(args.loadgen_journal)
+                            if args.loadgen_journal
+                            else getattr(backend, "cfg", None)
+                            and backend.cfg.root / "loadgen.jsonl")
+            broker = ResourceBroker(
+                sup, bcfg, serve_command=args.serve_command,
+                loadgen_journal=journal_path,
+                warm_standbys=args.warm_standbys)
+            broker.start()
+            got = sup.run_until_step(
+                args.until_step, poll_secs=poll_secs,
+                timeout_secs=args.poll_timeout_s,
+                target_worker=args.target_worker,
+                on_tick=broker.tick)
+            print(json.dumps({**got, "autoscale": broker.summary()},
+                             default=str))
         else:
             print(json.dumps(sup.run_until_step(
                 args.until_step, poll_secs=poll_secs,

@@ -405,21 +405,23 @@ class ClusterSupervisor:
 
     def run_until_step(self, target: int, poll_secs: float = 1.0,
                        timeout_secs: float = 24 * 3600.0,
-                       target_worker: int | None = None) -> dict[str, Any]:
+                       target_worker: int | None = None,
+                       on_tick: Any = None) -> dict[str, Any]:
         """Launch training and supervise it to ``target`` steps; the
         cluster is stopped on EVERY exit path (success, below-quorum
         failure, timeout, Ctrl-C)."""
         self.backend.run_train()
         try:
             return self.supervise_until_step(target, poll_secs, timeout_secs,
-                                             target_worker=target_worker)
+                                             target_worker=target_worker,
+                                             on_tick=on_tick)
         finally:
             self.backend.kill_all()
 
     def supervise_until_step(self, target: int, poll_secs: float = 1.0,
                              timeout_secs: float = 24 * 3600.0,
-                             target_worker: int | None = None
-                             ) -> dict[str, Any]:
+                             target_worker: int | None = None,
+                             on_tick: Any = None) -> dict[str, Any]:
         """Supervise the running cluster until ``target`` progress.
 
         ``target_worker``: count progress toward the target from ONE
@@ -429,7 +431,15 @@ class ClusterSupervisor:
         same progress channel, and the run is over when the
         PUBLISHER's train step hits the target, not when some busy
         replica has served ``target`` requests. None = the fastest
-        worker (the historical behavior)."""
+        worker (the historical behavior).
+
+        ``on_tick``: an optional ``callable(poll_dict) -> bool`` run
+        once per poll tick, after the target check and before failure
+        detection — the seam the resource broker (launch/broker.py)
+        plugs into. It runs ON the supervise thread, so a roster change
+        it performs cannot race this loop's per-worker trackers; a
+        True return declares the roster changed and resets them (the
+        same discipline as this loop's own reconfigures)."""
         cfg = self.cfg
         deadline = time.monotonic() + timeout_secs
         pending_restart: dict[int, float] = {}  # worker -> due monotonic
@@ -592,6 +602,25 @@ class ClusterSupervisor:
                     self.reconfigure(resize[1], trigger="fault_plan",
                                      poll_secs=min(poll_secs, 0.5))
                     cfg = self.cfg  # quorum may have rescaled
+                    reset_roster_state()
+                    time.sleep(poll_secs)
+                    continue
+            # ---- broker tick ------------------------------------------
+            # A True return declares the roster changed under us: the
+            # per-worker trackers describe workers that may no longer
+            # exist, so they reset exactly as after this loop's own
+            # reconfigures. A broken callback must not take down the
+            # supervision it rides on.
+            if on_tick is not None:
+                try:
+                    tick_changed = bool(on_tick(got))
+                except Exception:
+                    logger.exception("on_tick callback failed — "
+                                     "supervision continues without it "
+                                     "this tick")
+                    tick_changed = False
+                if tick_changed:
+                    cfg = self.cfg
                     reset_roster_state()
                     time.sleep(poll_secs)
                     continue

@@ -75,7 +75,7 @@ from .report import load_jsonl
 INVARIANTS = ("terminal_state", "metrics_log", "determinism",
               "causality", "checkpoint_integrity", "reconfigure",
               "serve_outcomes", "serve_digest", "serve_monotone",
-              "decode_swap")
+              "decode_swap", "autoscale")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -435,6 +435,110 @@ def check_reconfigure(trial_dir: str | Path, outcome: dict,
 
 
 # ---------------------------------------------------------------------------
+# (11) autoscale: every roster change in a brokered run is licensed
+# ---------------------------------------------------------------------------
+
+def check_autoscale(outcome: dict, journal_records: list[dict]
+                    ) -> tuple[list[Violation], bool]:
+    """Invariant (11), replayed from the journal alone. Returns
+    ``(violations, applicable)`` — not applicable (verdict: skipped)
+    for runs with no broker and no autoscale records.
+
+    The causal-license rule, same discipline as invariant 6: a
+    brokered run's roster may only change because a recorded signal
+    crossed its recorded threshold. Three claims:
+
+    * every ``autoscale begin`` carries a license that actually holds
+      — ``value op threshold`` must be true of the numbers the broker
+      itself journaled (a begin whose own evidence contradicts it is
+      a fabricated license);
+    * decisions are single-flight and closed: each begin is followed
+      by its ``complete`` or ``error`` before the next begin (the
+      broker's cooldown-from-settlement discipline), and no begin is
+      left dangling at the end of the run;
+    * every cluster ``reshape`` in a brokered run is consumed against
+      a preceding unconsumed license — an ``autoscale begin`` or a
+      supervisor ``reconfigure begin`` (fault-path reshapes keep
+      their own license) — and a reshape consuming an autoscale
+      license must land on the world that begin declared
+      (``new_serve + new_train``). Silent scaling fails replay.
+    """
+    recs = [r for r in journal_records
+            if r.get("event") == schema.AUTOSCALE]
+    applicable = bool(recs) or bool(outcome.get("broker"))
+    out: list[Violation] = []
+    if not applicable:
+        return out, False
+
+    open_begin: dict | None = None
+    for r in recs:
+        action = r.get("action")
+        if action == "begin":
+            v, thr, op = r.get("value"), r.get("threshold"), r.get("op")
+            if not (isinstance(v, (int, float))
+                    and isinstance(thr, (int, float))
+                    and op in (">=", "<=")):
+                out.append(Violation(
+                    "autoscale",
+                    f"autoscale begin ({r.get('decision')}) with a "
+                    f"malformed license: value={v!r} op={op!r} "
+                    f"threshold={thr!r}"))
+            elif not (v >= thr if op == ">=" else v <= thr):
+                out.append(Violation(
+                    "autoscale",
+                    f"autoscale begin ({r.get('decision')}) licensed by "
+                    f"{r.get('trigger')}={v} {op} {thr}, which does not "
+                    "hold — the recorded signal never crossed the "
+                    "recorded threshold"))
+            if open_begin is not None:
+                out.append(Violation(
+                    "autoscale",
+                    "overlapping autoscale decisions: a second begin "
+                    f"({r.get('decision')}) before the previous one "
+                    f"({open_begin.get('decision')}) completed — the "
+                    "broker is single-flight by construction"))
+            open_begin = r
+        elif action in ("complete", "error"):
+            open_begin = None
+    if open_begin is not None:
+        out.append(Violation(
+            "autoscale",
+            f"autoscale begin ({open_begin.get('decision')}) never "
+            "closed by a complete or error record"))
+
+    # license-consumption walk over the whole journal, in order
+    licenses: list[dict | None] = []  # None = supervisor reconfigure
+    for r in journal_records:
+        ev, action = r.get("event"), r.get("action")
+        if ev == schema.AUTOSCALE and action == "begin":
+            licenses.append(r)
+        elif (ev == schema.RECONFIGURE and action == "begin"
+                and r.get("layer") == "supervisor"):
+            licenses.append(None)
+        elif ev == schema.RECONFIGURE and action == "reshape":
+            if not licenses:
+                out.append(Violation(
+                    "autoscale",
+                    f"roster reshape {r.get('old_world')} -> "
+                    f"{r.get('new_world')} with no preceding autoscale "
+                    "or reconfigure begin — an unlicensed roster change "
+                    "in a brokered run"))
+                continue
+            lic = licenses.pop()
+            if lic is not None:
+                want = lic.get("new_serve", 0) + lic.get("new_train", 0)
+                got_world = r.get("new_world")
+                if isinstance(got_world, int) and got_world != want:
+                    out.append(Violation(
+                        "autoscale",
+                        f"reshape lands on world {got_world} but its "
+                        f"licensing autoscale begin declared "
+                        f"{lic.get('new_serve')} serving + "
+                        f"{lic.get('new_train')} train = {want}"))
+    return out, True
+
+
+# ---------------------------------------------------------------------------
 # (7-9) serving invariants (the online inference tier under chaos)
 # ---------------------------------------------------------------------------
 
@@ -761,6 +865,11 @@ def check_run(trial_dir: str | Path, outcome: dict | None = None,
         # only trials whose replicas ran the decode workload make the
         # swap-during-generation claim
         skipped.add("decode_swap")
+    autoscale_violations, autoscale_applicable = check_autoscale(
+        outcome, journal_all)
+    violations += autoscale_violations
+    if not autoscale_applicable:
+        skipped.add("autoscale")
 
     restarts_by_worker: dict[int, int] = {}
     for r in recovery:

@@ -208,6 +208,59 @@ def summarize_recovery(path: str | Path) -> dict[str, Any]:
     return summarize_recovery_events(load_recovery_events(path))
 
 
+def summarize_autoscale(records: list[dict]) -> dict[str, Any]:
+    """Aggregate a run's ``event: "autoscale"`` records (the resource
+    broker's decision journal, ``launch/broker.py``) into its scaling
+    evidence:
+
+    * ``decisions`` / ``completed`` / ``errors`` — begin records and
+      how each closed,
+    * ``by_trigger`` / ``by_direction`` — which signal fired each
+      decision and which way the roster moved,
+    * ``reaction_s`` — detect→capacity-live latency percentiles from
+      the ``complete`` records (the broker's MTTR analogue),
+    * ``flaps`` — consecutive opposite-direction decisions closer than
+      twice the recorded cooldown: the oscillation the hysteresis
+      band exists to prevent, surfaced so a campaign can gate on it
+      staying zero.
+    """
+    begins = [r for r in records if r.get("action") == "begin"]
+    completes = [r for r in records if r.get("action") == "complete"]
+    errors = [r for r in records if r.get("action") == "error"]
+    by_trigger: dict[str, int] = {}
+    by_direction: dict[str, int] = {}
+    for r in begins:
+        t = r.get("trigger", "?")
+        by_trigger[t] = by_trigger.get(t, 0) + 1
+        d = r.get("decision", "?")
+        by_direction[d] = by_direction.get(d, 0) + 1
+    flaps = 0
+    prev: dict | None = None
+    for r in begins:
+        if prev is not None and r.get("decision") != prev.get("decision"):
+            gap = (r.get("time") or 0) - (prev.get("time") or 0)
+            lim = 2 * float(r.get("cooldown_s") or 30.0)
+            if 0 <= gap < lim:
+                flaps += 1
+        prev = r
+    out: dict[str, Any] = {"decisions": len(begins),
+                           "completed": len(completes),
+                           "errors": len(errors),
+                           "by_trigger": by_trigger,
+                           "by_direction": by_direction,
+                           "flaps": flaps,
+                           "reaction_s": {}}
+    reactions = sorted(float(r["reaction_s"]) for r in completes
+                       if isinstance(r.get("reaction_s"), (int, float)))
+    if reactions:
+        out["reaction_s"] = {
+            "mean": round(sum(reactions) / len(reactions), 3),
+            "p50": _percentile(reactions, 0.50),
+            "p99": _percentile(reactions, 0.99),
+            "max": reactions[-1]}
+    return out
+
+
 def summarize_chaos(path: str | Path) -> dict[str, Any]:
     """Aggregate a chaos campaign's ``chaos_report.jsonl`` (one
     ``event: "chaos_trial"`` record per trial, written by
@@ -225,6 +278,7 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
     mttr_all: list[float] = []
     fault_trials: list[dict[str, Any]] = []
     serving_trials: list[dict[str, Any]] = []
+    autoscale_trials: list[dict[str, Any]] = []
     reconfigures = 0
     swaps_by_tier: dict[str, int] = {}
     quant_fallbacks = 0
@@ -261,6 +315,15 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
                 key = tier or "fp32"
                 swaps_by_tier[key] = swaps_by_tier.get(key, 0) + (n or 0)
             quant_fallbacks += sw.get("quant_sidecar_fallbacks") or 0
+        a = rec.get("autoscale")
+        if a is not None:
+            autoscale_trials.append({
+                "trial": rec.get("trial"),
+                "decisions": a.get("decisions", 0),
+                "fired": a.get("fired", 0),
+                "by_direction": a.get("by_direction") or {},
+                "flaps": a.get("flaps", 0),
+                "reaction_p99_s": (a.get("reaction_s") or {}).get("p99")})
         f = rec.get("faults")
         if f is not None:
             fault_trials.append({"trial": rec.get("trial"),
@@ -356,7 +419,27 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
                 "swaps_by_tier": swaps_by_tier,
                 "quant_sidecar_fallbacks": quant_fallbacks,
                 "per_trial": serving_trials}
-                if serving_trials else None)}
+                if serving_trials else None),
+            # brokered campaigns: the autoscale evidence per trial and
+            # in aggregate — the nightly broker gate asserts decisions
+            # fired (> 0), in BOTH directions, with zero flaps
+            "autoscale": ({
+                "trials": len(autoscale_trials),
+                "decisions": sum(t["decisions"] or 0
+                                 for t in autoscale_trials),
+                "fired": sum(t["fired"] or 0 for t in autoscale_trials),
+                "scale_ups": sum(
+                    t["by_direction"].get("scale_up_serving", 0)
+                    for t in autoscale_trials),
+                "scale_downs": sum(
+                    t["by_direction"].get("scale_down_serving", 0)
+                    for t in autoscale_trials),
+                "flaps": sum(t["flaps"] or 0 for t in autoscale_trials),
+                "reaction_p99_s": max(
+                    (t["reaction_p99_s"] for t in autoscale_trials
+                     if t["reaction_p99_s"] is not None), default=None),
+                "per_trial": autoscale_trials}
+                if autoscale_trials else None)}
 
 
 def summarize_journal(path: str | Path) -> dict[str, Any]:

@@ -54,6 +54,7 @@ LIFECYCLE = "lifecycle"
 SPAWN = "spawn"
 CHAOS_TRIAL = "chaos_trial"
 EVAL = "eval"
+AUTOSCALE = "autoscale"
 
 # Fields any journaled record may carry regardless of kind: the sink
 # stamps ``ts``, emitters stamp ``time``, the supervisor stamps ``seed``
@@ -247,20 +248,38 @@ _declare(EventSchema(
 
 # Serving liveness counter (servesvc/server.py, the replica's
 # train_log.jsonl — the supervisor's progress probe reads ``step``).
-_declare(EventSchema(HEARTBEAT, required=("step",)))
+# The optional fields are the replica's live PRESSURE snapshot — queue
+# depth at the admission bound, and (decode replicas) KV block-pool
+# occupancy — so ``parse_poll_output`` surfaces per-replica pressure to
+# the resource broker without a second channel.
+_declare(EventSchema(
+    HEARTBEAT,
+    required=("step",),
+    optional=("queue_depth", "queue_limit", "kv_blocks_free",
+              "kv_blocks_total", "kv_blocks_reserved",
+              "decode_waiting"),
+))
 
 # Load-generator journal (servesvc/loadgen.py loadgen.jsonl): every
-# issued request and its exactly-one terminal outcome.
+# issued request and its exactly-one terminal outcome, plus periodic
+# rolling-window pressure snapshots (``window``) — the live signal the
+# resource broker (launch/broker.py) scales the roster on.
 _declare(EventSchema(
     LOAD,
-    required=("action", "id"),
+    required=("action",),
     actions={
-        "issue": _act(),
-        "outcome": _act(("status",),
+        "issue": _act(("id",)),
+        "outcome": _act(("id", "status"),
                         ("reason", "model_step", "tier", "attempts",
                          "endpoint", "latency_ms",
                          # decode sweeps: the two-number latency split
                          "ttft_ms", "itl_ms", "tokens")),
+        # rolling-window snapshot over the last ``window_s`` seconds:
+        # latency percentiles only when the window saw ok responses
+        "window": _act(("window_s", "terminal", "responses",
+                        "rejected", "errors", "reject_rate"),
+                       ("issued", "p50_ms", "p99_ms", "ttft_p50_ms",
+                        "ttft_p99_ms", "throughput_rps")),
     },
 ))
 
@@ -307,7 +326,7 @@ _declare(EventSchema(
               "step", "target", "duration_s", "verdicts", "violations"),
     optional=("mttr", "boot_s", "stall_timeout_s", "faults",
               "reconfigures", "final_world", "serving", "serve_swaps",
-              "shrunk"),
+              "shrunk", "broker", "autoscale"),
 ))
 
 # Continuous evaluator (evalsvc/evaluator.py eval_log.jsonl).
@@ -315,6 +334,27 @@ _declare(EventSchema(
     EVAL,
     required=("step", "num_examples", "precision_at_1", "loss",
               "seconds"),
+))
+
+# Resource-broker decisions (launch/broker.py) — the causal LICENSE the
+# ``autoscale`` replay invariant requires for every roster change in a
+# brokered run.  ``begin`` names the signal that crossed its threshold
+# (``value op threshold`` must hold, checked at replay), ``complete``
+# closes the episode once the new capacity is LIVE and carries the
+# detect→capacity-live reaction time.
+_declare(EventSchema(
+    AUTOSCALE,
+    required=("action",),
+    actions={
+        "begin": _act(("decision", "trigger", "value", "threshold",
+                       "op", "old_serve", "new_serve", "old_train",
+                       "new_train"),
+                      ("window_s", "cooldown_s")),
+        "complete": _act(("decision", "trigger", "reaction_s", "serve",
+                          "train"),
+                         ("worker", "grown", "dropped")),
+        "error": _act(("decision", "error")),
+    },
 ))
 
 

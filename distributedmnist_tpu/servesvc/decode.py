@@ -262,6 +262,19 @@ class DecodeReplica(ServingReplica):
         s.first_token_at = None
         self._prefill(s, restart=True)
 
+    def _pressure_fields(self) -> dict:
+        """Queue occupancy plus KV block-pool pressure: free blocks
+        against the usable pool (block 0 is the reserved null block)
+        and the deferred-admission line — a pool near empty is the
+        decode-side signal the broker scales on. Reads only; the
+        allocator's single writer is this same batcher thread."""
+        alloc = self.cache.allocator
+        return {**super()._pressure_fields(),
+                "kv_blocks_free": alloc.available,
+                "kv_blocks_total": alloc.num_blocks - 1,
+                "kv_blocks_reserved": len(alloc.in_use),
+                "decode_waiting": len(self._waiting)}
+
     # -- the decode loop ------------------------------------------------
 
     def _batch_loop(self) -> None:  # overrides the classification batcher

@@ -76,7 +76,9 @@ def run_load(client: ServeClient, num_requests: int | None,
              journal_path: str | Path | None = None,
              stop_event: threading.Event | None = None,
              deadline_s: float | None = None,
-             decode: bool = False) -> dict[str, Any]:
+             decode: bool = False,
+             window_s: float = 0.0,
+             snapshot_every_s: float = 0.0) -> dict[str, Any]:
     """Drive the cluster closed-loop until ``num_requests`` terminal
     outcomes (or ``stop_event``, whichever first; one of the two must
     be provided). Returns the summary; journals to ``journal_path``.
@@ -86,7 +88,14 @@ def run_load(client: ServeClient, num_requests: int | None,
     outcome records then carry the two decode latency numbers
     alongside e2e: ``ttft_ms`` (time-to-first-token) and ``itl_ms``
     (mean per-token inter-arrival), and the summary aggregates their
-    p50/p99 plus total ``tokens_streamed``."""
+    p50/p99 plus total ``tokens_streamed``.
+
+    ``window_s`` > 0 (with a journal) turns on rolling-window pressure
+    snapshots: every ``snapshot_every_s`` (defaults to ``window_s/2``)
+    a ``{"event": "load", "action": "window"}`` record summarizing the
+    last ``window_s`` seconds of outcomes lands in the journal — the
+    live signal the resource broker (and a human tailing the file)
+    reads, where the end-of-run summary only exists after the fact."""
     if num_requests is None and stop_event is None:
         raise ValueError("run_load needs num_requests or stop_event")
     sink = JsonlSink(journal_path) if journal_path is not None else None
@@ -145,14 +154,100 @@ def run_load(client: ServeClient, num_requests: int | None,
                for i in range(max(1, concurrency))]
     for t in threads:
         t.start()
+
+    done = threading.Event()
+
+    def snapshotter() -> None:
+        every = snapshot_every_s if snapshot_every_s > 0 else window_s / 2
+        while not done.wait(every):
+            with out_lock:
+                snap = summarize_window(outcomes, issued[0],
+                                        time.time(), window_s)
+            journal({"event": "load", "action": "window",
+                     "time": time.time(), **snap})
+
+    snap_thread = None
+    if sink is not None and window_s > 0:
+        snap_thread = threading.Thread(target=snapshotter, daemon=True,
+                                       name="loadgen-window")
+        snap_thread.start()
+
     for t in threads:
         # closed-loop workers exit on their own (count reached or stop
         # set); the join bounds a wedged worker by its own deadline
         t.join()
     duration = time.time() - t_start
+    if snap_thread is not None:
+        done.set()
+        snap_thread.join()
     if sink is not None:
         sink.close()
     return summarize_outcomes(outcomes, issued[0], duration)
+
+
+def summarize_window(outcomes: list[dict], issued: int, now: float,
+                     window_s: float) -> dict[str, Any]:
+    """The rolling-window pressure snapshot — a pure function of the
+    outcome records whose ``time`` falls in ``[now - window_s, now]``
+    (deterministic in its inputs; the broker's property tests feed it
+    synthetic traces). Latency/TTFT percentiles appear only when the
+    window saw ok responses carrying them."""
+    recent = [r for r in outcomes
+              if isinstance(r.get("time"), (int, float))
+              and r["time"] >= now - window_s]
+    ok = [r for r in recent if r.get("status") == "ok"]
+    rejected = [r for r in recent if r.get("status") == "rejected"]
+    errors = [r for r in recent if r.get("status") == "error"]
+    out: dict[str, Any] = {
+        "window_s": window_s,
+        "issued": issued,
+        "terminal": len(recent),
+        "responses": len(ok),
+        "rejected": len(rejected),
+        "errors": len(errors),
+        "reject_rate": round(len(rejected) / max(1, len(recent)), 4),
+        "throughput_rps": round(len(recent) / max(window_s, 1e-9), 2),
+    }
+    lat = sorted(r["latency_ms"] for r in ok
+                 if isinstance(r.get("latency_ms"), (int, float)))
+    if lat:
+        out["p50_ms"] = _percentile(lat, 0.50)
+        out["p99_ms"] = _percentile(lat, 0.99)
+    ttft = sorted(r["ttft_ms"] for r in ok
+                  if isinstance(r.get("ttft_ms"), (int, float)))
+    if ttft:
+        out["ttft_p50_ms"] = _percentile(ttft, 0.50)
+        out["ttft_p99_ms"] = _percentile(ttft, 0.99)
+    return out
+
+
+def read_latest_window(journal_path: str | Path,
+                       tail_bytes: int = 1 << 16) -> dict | None:
+    """The newest ``window`` snapshot in a (possibly still-growing)
+    loadgen journal, or None. Reads only the file tail and scans
+    backwards past torn lines — the broker polls this every second
+    against a journal another process is appending to."""
+    path = Path(journal_path)
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, 2)
+            size = f.tell()
+            f.seek(max(0, size - tail_bytes))
+            chunk = f.read().decode("utf-8", errors="replace")
+    except OSError:
+        return None
+    for line in reversed(chunk.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # torn head/tail line
+        if (isinstance(rec, dict) and rec.get("event") == "load"
+                and rec.get("action") == "window"):
+            return rec
+    return None
 
 
 def summarize_outcomes(outcomes: list[dict], issued: int,

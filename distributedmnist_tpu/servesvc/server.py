@@ -211,6 +211,14 @@ class ServingReplica:
         with self._journal_lock:
             self._terminals += 1
 
+    def _pressure_fields(self) -> dict:
+        """Live replica pressure stamped onto every heartbeat — queue
+        occupancy against the admission bound here; the decode replica
+        adds KV block-pool occupancy. What ``parse_poll_output``
+        surfaces to the resource broker without a second channel."""
+        return {"queue_depth": self._queue.qsize(),
+                "queue_limit": max(1, self.scfg.queue_depth)}
+
     def _maybe_heartbeat(self) -> None:
         with self._journal_lock:
             n = self._terminals
@@ -218,7 +226,8 @@ class ServingReplica:
                 return
             self._last_heartbeat = n
             self._heartbeat.write({"event": "heartbeat", "step": n,
-                                   "time": time.time()})
+                                   "time": time.time(),
+                                   **self._pressure_fields()})
 
     # -- weights ------------------------------------------------------
 

@@ -1,0 +1,553 @@
+"""Resource-broker tests: the pure decision core replayed over signal
+traces (determinism, hysteresis, cooldown, bounds), the executor over a
+scripted roster backend (begin -> reshape -> complete ordering,
+worker_commands role plumbing), the autoscale replay invariant, and
+the chaos-side broker config surface.
+
+The decision core is a pure function of (config, signals,
+last-change-time, now) — the property tests here drive it with seeded
+random traces and assert the invariants the hysteresis band and
+cooldown exist to provide: the roster never leaves its bounds and
+never flaps.
+"""
+
+import dataclasses
+import json
+import random
+import time
+
+import pytest
+
+from distributedmnist_tpu.core.config import BrokerConfig
+from distributedmnist_tpu.launch.broker import (SCALE_DOWN, SCALE_UP,
+                                                Decision, ResourceBroker,
+                                                collect_signals, decide,
+                                                tail_heartbeat,
+                                                threshold_holds)
+from distributedmnist_tpu.launch.chaos import (ChaosConfig,
+                                               _merge_load_summaries)
+from distributedmnist_tpu.launch.cluster import (ClusterError,
+                                                 LocalClusterConfig)
+from distributedmnist_tpu.launch.supervisor import (ClusterSupervisor,
+                                                    SupervisorConfig)
+from distributedmnist_tpu.obsv.invariants import check_autoscale
+from distributedmnist_tpu.obsv.journal import summarize_autoscale
+from distributedmnist_tpu.obsv.schema import validate_event
+
+pytestmark = pytest.mark.tier1
+
+_CFG = BrokerConfig(cooldown_s=10.0, min_serve_replicas=1,
+                    max_serve_replicas=3, min_train_workers=1,
+                    max_train_workers=4)
+
+
+def _sig(**kw):
+    return dict(kw)
+
+
+# ---------------------------------------------------------------------------
+# decide(): the pure core
+# ---------------------------------------------------------------------------
+
+def test_decide_is_deterministic():
+    args = (_CFG, 1, 2, _sig(p99_ms=900.0, reject_rate=0.0), None, 100.0)
+    assert decide(*args) == decide(*args)
+    assert decide(*args) == Decision(SCALE_UP, "p99_ms", 900.0,
+                                     _CFG.p99_high_ms, ">=", 1, 2, 2, 1)
+
+
+def test_decide_no_signals_no_decision():
+    assert decide(_CFG, 2, 2, {}, None, 100.0) is None
+
+
+def test_decide_dead_band_is_hysteresis():
+    """A signal hovering BETWEEN the low and high marks decides
+    nothing in either direction — the band is dead by design."""
+    mid = (_CFG.p99_low_ms + _CFG.p99_high_ms) / 2
+    assert decide(_CFG, 2, 2, _sig(p99_ms=mid), None, 100.0) is None
+
+
+def test_decide_cooldown_suppresses_everything():
+    hot = _sig(p99_ms=2 * _CFG.p99_high_ms)
+    assert decide(_CFG, 1, 2, hot, last_change_t=95.0, now=100.0) is None
+    got = decide(_CFG, 1, 2, hot, last_change_t=95.0,
+                 now=95.0 + _CFG.cooldown_s)
+    assert got is not None and got.decision == SCALE_UP
+
+
+def test_decide_scale_up_respects_both_bounds():
+    hot = _sig(reject_rate=1.0)
+    # serving already at max
+    assert decide(_CFG, _CFG.max_serve_replicas, 2, hot, None, 0.0) is None
+    # no train worker to give up (the publisher is protected)
+    assert decide(_CFG, 1, _CFG.min_train_workers, hot, None, 0.0) is None
+
+
+def test_decide_scale_down_needs_every_signal_calm():
+    calm_but_one = _sig(p99_ms=_CFG.p99_low_ms,
+                        reject_rate=_CFG.reject_high)
+    assert decide(_CFG, 2, 1, calm_but_one, None, 0.0) is None
+    calm = _sig(p99_ms=_CFG.p99_low_ms, reject_rate=0.0)
+    got = decide(_CFG, 2, 1, calm, None, 0.0)
+    assert got is not None and got.decision == SCALE_DOWN
+    assert got.new_serve == 1 and got.new_train == 2
+    # at the serving floor nothing shrinks, however calm
+    assert decide(_CFG, _CFG.min_serve_replicas, 1, calm, None, 0.0) is None
+
+
+def test_decide_kv_pressure_is_inverted():
+    got = decide(_CFG, 1, 2, _sig(kv_free_frac=0.02), None, 0.0)
+    assert got is not None and got.decision == SCALE_UP
+    assert got.trigger == "kv_free_frac" and got.op == "<="
+
+
+def test_decide_scale_down_caps_train_growth():
+    calm = _sig(p99_ms=0.0)
+    got = decide(_CFG, 2, _CFG.max_train_workers, calm, None, 0.0)
+    assert got is not None and got.decision == SCALE_DOWN
+    assert got.new_train == _CFG.max_train_workers  # shed, don't grow
+
+
+def test_decide_train_rate_never_triggers():
+    assert decide(_CFG, 1, 2, _sig(train_steps_per_s=1e9), None, 0.0) is None
+
+
+def test_decide_property_bounds_and_no_flap():
+    """Property: replay seeded random signal traces through a stateful
+    loop exactly the way the broker does (cooldown from the last
+    change) — the roster NEVER leaves its configured bounds, and two
+    consecutive opposite-direction decisions are never closer than the
+    cooldown (no flapping)."""
+    for trial in range(20):
+        rng = random.Random(1000 + trial)
+        serve, train = 1, 3
+        last_t = None
+        changes: list[tuple[float, str]] = []
+        for step in range(200):
+            now = step * 1.0
+            sig = {}
+            if rng.random() < 0.9:
+                sig["p99_ms"] = rng.uniform(0, 2 * _CFG.p99_high_ms)
+            if rng.random() < 0.5:
+                sig["queue_frac"] = rng.random()
+            if rng.random() < 0.3:
+                sig["kv_free_frac"] = rng.random()
+            d = decide(_CFG, serve, train, sig, last_t, now)
+            if d is None:
+                continue
+            assert d.old_serve == serve and d.old_train == train
+            serve, train = d.new_serve, d.new_train
+            last_t = now
+            changes.append((now, d.decision))
+            assert _CFG.min_serve_replicas <= serve \
+                <= _CFG.max_serve_replicas
+            assert _CFG.min_train_workers <= train \
+                <= _CFG.max_train_workers
+        for (t0, d0), (t1, d1) in zip(changes, changes[1:]):
+            assert t1 - t0 >= _CFG.cooldown_s
+            # a reversal inside the cooldown window would be a flap
+            if d1 != d0:
+                assert t1 - t0 >= _CFG.cooldown_s
+
+
+# ---------------------------------------------------------------------------
+# signal collection
+# ---------------------------------------------------------------------------
+
+def test_threshold_holds_both_ops():
+    assert threshold_holds(5.0, ">=", 5.0)
+    assert not threshold_holds(4.9, ">=", 5.0)
+    assert threshold_holds(0.1, "<=", 0.1)
+    assert not threshold_holds(0.2, "<=", 0.1)
+
+
+def test_collect_signals_folds_window_and_heartbeats():
+    window = {"time": 100.0, "p99_ms": 321.0, "reject_rate": 0.25,
+              "ttft_p99_ms": 42.0}
+    hbs = [{"queue_depth": 2, "queue_limit": 8,
+            "kv_blocks_free": 10, "kv_blocks_total": 100},
+           {"queue_depth": 7, "queue_limit": 8,
+            "kv_blocks_free": 90, "kv_blocks_total": 100}]
+    sig = collect_signals(window, hbs, train_steps_per_s=3.5, now=101.0,
+                          window_s=10.0)
+    assert sig["p99_ms"] == 321.0 and sig["reject_rate"] == 0.25
+    assert sig["ttft_p99_ms"] == 42.0
+    assert sig["queue_frac"] == 7 / 8        # worst replica
+    assert sig["kv_free_frac"] == 10 / 100   # scarcest pool
+    assert sig["train_steps_per_s"] == 3.5
+
+
+def test_collect_signals_drops_stale_window():
+    window = {"time": 100.0, "p99_ms": 999.0}
+    sig = collect_signals(window, [], now=100.0 + 60.0, window_s=10.0)
+    assert "p99_ms" not in sig
+
+
+def test_tail_heartbeat_skips_torn_tail(tmp_path):
+    log = tmp_path / "train_log.jsonl"
+    log.write_text(
+        json.dumps({"event": "heartbeat", "step": 3,
+                    "queue_depth": 1}) + "\n"
+        + json.dumps({"event": "step", "step": 9}) + "\n"
+        + '{"event": "heartbeat", "step": 4, "queue_')  # torn write
+    hb = tail_heartbeat(tmp_path)
+    assert hb is not None and hb["step"] == 3
+    assert tail_heartbeat(tmp_path / "missing") is None
+
+
+# ---------------------------------------------------------------------------
+# ResourceBroker.execute over a scripted roster backend
+# ---------------------------------------------------------------------------
+
+class _FakeRoster:
+    """The backend surface the broker drives, over an in-memory roster
+    with a REAL LocalClusterConfig (so the worker_commands role
+    plumbing and resolved_standby_command guard run for real)."""
+
+    def __init__(self, tmp_path, num_workers, worker_commands,
+                 standby_command=""):
+        self.cfg = LocalClusterConfig(
+            name="fake", workdir=str(tmp_path), num_workers=num_workers,
+            train_command="train-payload",
+            worker_commands=worker_commands,
+            standby_command=standby_command)
+        self.ids = list(range(num_workers))
+        self.alive = {k: True for k in self.ids}
+        self.reshapes: list[dict] = []
+        self.restarted: list[int] = []
+        self.stopped: list[str] = []
+        self.promoted: list[int] = []
+        self.promote_ok = False
+        for k in self.ids:
+            self.cfg.worker_dir(k).mkdir(parents=True, exist_ok=True)
+
+    def workers(self):
+        return [{"worker": k, "pid": 1000 + k,
+                 "alive": self.alive.get(k, False),
+                 "logdir": str(self.cfg.worker_dir(k))}
+                for k in self.ids]
+
+    def status(self):
+        return {"state": "running", "workers": self.workers(), "idle": []}
+
+    def stop_all(self, worker="all"):
+        self.stopped.append(worker)
+        if worker != "all":
+            self.alive[int(worker)] = False
+
+    def reconfigure(self, new_num_workers, survivors=None):
+        old = list(self.ids)
+        keep = sorted(survivors if survivors is not None else old)
+        nxt = max(old) + 1
+        grown = []
+        while len(keep) < new_num_workers:
+            grown.append(nxt)
+            keep.append(nxt)
+            nxt += 1
+        self.ids = sorted(keep)
+        for k in grown:
+            self.alive[k] = True
+            self.cfg.worker_dir(k).mkdir(parents=True, exist_ok=True)
+        self.cfg = dataclasses.replace(self.cfg,
+                                       num_workers=new_num_workers)
+        rec = {"event": "reconfigure", "layer": "cluster",
+               "action": "reshape", "old_world": len(old),
+               "new_world": new_num_workers, "old_workers": old,
+               "workers": list(self.ids), "grown": grown}
+        self.reshapes.append(rec)
+        return rec
+
+    def restart_worker(self, k):
+        self.restarted.append(k)
+        self.alive[k] = True
+
+    def promote_standby(self, k):
+        self.promoted.append(k)
+        self.alive[k] = self.promote_ok or self.alive.get(k, False)
+        return self.promote_ok
+
+    def kill_all(self, worker="all"):
+        pass
+
+
+_SERVE_CMD = "serve-payload"
+
+
+def _brokered(tmp_path, num_workers=3, serve_ids=(1,), standby=""):
+    cmds = {str(k): _SERVE_CMD for k in serve_ids}
+    backend = _FakeRoster(tmp_path, num_workers, cmds,
+                          standby_command=standby)
+    sup = ClusterSupervisor(backend, SupervisorConfig(seed=7))
+    broker = ResourceBroker(sup, BrokerConfig(cooldown_s=0.0,
+                                              settle_timeout_s=5.0),
+                            serve_command=_SERVE_CMD)
+    return backend, sup, broker
+
+
+def test_broker_requires_serve_command(tmp_path):
+    backend = _FakeRoster(tmp_path, 2, {"1": _SERVE_CMD})
+    sup = ClusterSupervisor(backend, SupervisorConfig())
+    with pytest.raises(ValueError):
+        ResourceBroker(sup)
+
+
+def test_broker_scale_up_trades_trainer_for_replica(tmp_path):
+    backend, sup, broker = _brokered(tmp_path)
+    changed = broker.tick({"workers": backend.workers(),
+                           "worker_progress": {0: 5, 2: 5}})
+    # no pressure journaled anywhere -> no decision
+    assert changed is False and backend.reshapes == []
+
+    d = decide(broker.cfg, 1, 2, {"p99_ms": 900.0}, None, time.time())
+    assert d is not None
+    assert broker.execute(d, [1], [0, 2], time.time()) is True
+    # victim: the highest train id, never the publisher
+    assert backend.stopped == ["2"]
+    assert backend.reshapes[0]["workers"] == [0, 1, 3]
+    # the grown slot got the serving payload registered and cold-spawned
+    assert backend.cfg.worker_commands["3"] == _SERVE_CMD
+    assert backend.restarted == [3]
+
+    # settlement: the new replica's endpoint card going live closes the
+    # decision with a measured reaction time
+    (backend.cfg.worker_dir(3) / "serve.json").write_text("{}")
+    assert broker.tick({"workers": backend.workers()}) is False
+    actions = [r["action"] for r in sup.events
+               if r.get("event") == "autoscale"]
+    assert actions == ["begin", "complete"]
+    complete = [r for r in sup.events if r.get("action") == "complete"][0]
+    assert complete["serve"] == 2 and complete["train"] == 1
+    assert complete["worker"] == 3 and complete["dropped"] == 2
+    assert broker.fired == 1
+
+
+def test_broker_scale_down_returns_slot_to_training(tmp_path):
+    backend, sup, broker = _brokered(tmp_path, num_workers=3,
+                                     serve_ids=(1, 2))
+    d = decide(broker.cfg, 2, 1, {"p99_ms": 0.0}, None, time.time())
+    assert d is not None and d.decision == SCALE_DOWN
+    assert broker.execute(d, [1, 2], [0], time.time()) is True
+    # victim: the newest replica; a train worker grows back
+    assert backend.stopped == ["2"]
+    assert "2" not in backend.cfg.worker_commands
+    assert backend.cfg.worker_commands.get("1") == _SERVE_CMD
+    assert backend.restarted == [3]
+    assert "3" not in backend.cfg.worker_commands  # the slot trains
+
+    (backend.cfg.worker_dir(3) / "train_log.jsonl").write_text(
+        json.dumps({"event": "step", "step": 1}) + "\n")
+    broker.tick({"workers": backend.workers()})
+    complete = [r for r in sup.events if r.get("action") == "complete"][0]
+    assert complete["decision"] == SCALE_DOWN
+    assert complete["serve"] == 1 and complete["train"] == 2
+
+
+def test_broker_promotes_matching_standby_pool(tmp_path):
+    backend, sup, broker = _brokered(tmp_path, standby=_SERVE_CMD)
+    backend.promote_ok = True
+    d = decide(broker.cfg, 1, 2, {"p99_ms": 900.0}, None, time.time())
+    broker.execute(d, [1], [0, 2], time.time())
+    assert backend.promoted == [3]
+    assert backend.restarted == []  # warm path: no cold spawn
+    assert backend.cfg.worker_commands["3"] == _SERVE_CMD
+
+
+def test_broker_skips_pool_parked_on_wrong_payload(tmp_path):
+    backend, sup, broker = _brokered(tmp_path, standby="other-payload")
+    backend.promote_ok = True
+    d = decide(broker.cfg, 1, 2, {"p99_ms": 900.0}, None, time.time())
+    broker.execute(d, [1], [0, 2], time.time())
+    assert backend.promoted == []   # guard refused the role swap
+    assert backend.restarted == [3]
+
+
+def test_broker_settle_timeout_journals_error(tmp_path):
+    backend, sup, broker = _brokered(tmp_path)
+    broker.cfg = BrokerConfig(cooldown_s=0.0, settle_timeout_s=0.0)
+    d = decide(broker.cfg, 1, 2, {"p99_ms": 900.0}, None, time.time())
+    broker.execute(d, [1], [0, 2], time.time())
+    time.sleep(0.02)  # past the zero settle budget; no serve.json ever
+    broker.tick({"workers": backend.workers()})
+    actions = [r["action"] for r in sup.events
+               if r.get("event") == "autoscale"]
+    assert actions == ["begin", "error"]
+    assert broker.fired == 0
+
+
+def test_broker_events_validate_against_schema(tmp_path):
+    backend, sup, broker = _brokered(tmp_path)
+    d = decide(broker.cfg, 1, 2, {"p99_ms": 900.0}, None, time.time())
+    broker.execute(d, [1], [0, 2], time.time())
+    (backend.cfg.worker_dir(3) / "serve.json").write_text("{}")
+    broker.tick({"workers": backend.workers()})
+    recs = [r for r in sup.events if r.get("event") == "autoscale"]
+    assert len(recs) == 2
+    for r in recs:
+        validate_event(r, source="test")
+
+
+# ---------------------------------------------------------------------------
+# the autoscale replay invariant
+# ---------------------------------------------------------------------------
+
+def _begin(decision=SCALE_UP, value=900.0, threshold=500.0, op=">=",
+           t=100.0, **kw):
+    return {"event": "autoscale", "layer": "broker", "action": "begin",
+            "decision": decision, "trigger": "p99_ms", "value": value,
+            "threshold": threshold, "op": op, "old_serve": 1,
+            "new_serve": 2, "old_train": 2, "new_train": 1,
+            "cooldown_s": 10.0, "time": t, **kw}
+
+
+def _complete(decision=SCALE_UP, t=105.0):
+    return {"event": "autoscale", "layer": "broker", "action": "complete",
+            "decision": decision, "trigger": "p99_ms", "reaction_s": 2.0,
+            "serve": 2, "train": 1, "time": t}
+
+
+def _reshape(new_world=3, **kw):
+    return {"event": "reconfigure", "layer": "cluster",
+            "action": "reshape", "old_world": 3, "new_world": new_world,
+            **kw}
+
+
+def test_check_autoscale_not_applicable_without_broker():
+    violations, applicable = check_autoscale({}, [_reshape()])
+    assert not applicable and violations == []
+
+
+def test_check_autoscale_licensed_run_is_green():
+    journal = [_begin(), _reshape(new_world=3), _complete()]
+    violations, applicable = check_autoscale({"broker": True}, journal)
+    assert applicable and violations == []
+
+
+def test_check_autoscale_flags_unlicensed_reshape():
+    violations, _ = check_autoscale({"broker": True}, [_reshape()])
+    assert any("unlicensed" in v.detail for v in violations)
+
+
+def test_check_autoscale_flags_license_that_does_not_hold():
+    journal = [_begin(value=100.0, threshold=500.0, op=">="),
+               _reshape(), _complete()]
+    violations, _ = check_autoscale({"broker": True}, journal)
+    assert any("never crossed" in v.detail for v in violations)
+
+
+def test_check_autoscale_flags_world_mismatch():
+    journal = [_begin(), _reshape(new_world=7), _complete()]
+    violations, _ = check_autoscale({"broker": True}, journal)
+    assert any("lands on world 7" in v.detail for v in violations)
+
+
+def test_check_autoscale_flags_dangling_and_overlapping_begins():
+    violations, _ = check_autoscale({"broker": True}, [_begin()])
+    assert any("never closed" in v.detail for v in violations)
+    violations, _ = check_autoscale(
+        {"broker": True},
+        [_begin(t=100.0), _begin(t=101.0, decision=SCALE_DOWN)])
+    assert any("overlapping" in v.detail for v in violations)
+
+
+def test_check_autoscale_supervisor_reconfigure_keeps_own_license():
+    """A fault-path reshape licensed by the supervisor's own
+    reconfigure begin does not consume (or need) an autoscale one."""
+    journal = [
+        {"event": "reconfigure", "layer": "supervisor",
+         "action": "begin", "old_world": 3, "new_world": 2},
+        _reshape(new_world=2),
+    ]
+    violations, applicable = check_autoscale({"broker": True}, journal)
+    assert applicable and violations == []
+
+
+# ---------------------------------------------------------------------------
+# summaries
+# ---------------------------------------------------------------------------
+
+def test_summarize_autoscale_counts_and_flaps():
+    recs = [
+        _begin(t=100.0), _complete(t=102.0),
+        # a reversal 5 s after a 10 s-cooldown decision: one flap
+        _begin(decision=SCALE_DOWN, value=1.0, threshold=150.0,
+               op="<=", t=105.0),
+        _complete(decision=SCALE_DOWN, t=106.0),
+        {"event": "autoscale", "action": "error",
+         "decision": SCALE_UP, "error": "boom", "time": 107.0},
+    ]
+    got = summarize_autoscale(recs)
+    assert got["decisions"] == 2 and got["completed"] == 2
+    assert got["errors"] == 1
+    assert got["by_direction"] == {SCALE_UP: 1, SCALE_DOWN: 1}
+    assert got["flaps"] == 1
+    assert got["reaction_s"]["max"] == 2.0
+
+
+def test_summarize_autoscale_spaced_reversal_is_not_a_flap():
+    recs = [_begin(t=100.0), _complete(t=101.0),
+            _begin(decision=SCALE_DOWN, t=100.0 + 50.0)]
+    assert summarize_autoscale(recs)["flaps"] == 0
+
+
+def test_merge_load_summaries_sums_counts_takes_worst_tails():
+    a = {"issued": 10, "terminal": 10, "dropped": 0, "responses": 9,
+         "rejected": 1, "errors": 0, "by_reason": {"rejected:overload": 1},
+         "duration_s": 2.0, "model_steps_served": [3],
+         "tiers_served": ["fp32"],
+         "latency_ms": {"p50": 5.0, "p99": 20.0}}
+    b = {"issued": 20, "terminal": 20, "dropped": 0, "responses": 20,
+         "rejected": 0, "errors": 0, "by_reason": {},
+         "duration_s": 3.0, "model_steps_served": [3, 5],
+         "tiers_served": ["fp32"],
+         "latency_ms": {"p50": 4.0, "p99": 80.0}}
+    got = _merge_load_summaries([a, None, b])
+    assert got["issued"] == 30 and got["dropped"] == 0
+    assert got["rejected"] == 1
+    assert got["by_reason"] == {"rejected:overload": 1}
+    assert got["latency_ms"]["p99"] == 80.0  # worst phase bounds the gate
+    assert got["model_steps_served"] == [3, 5]
+    assert got["phases_merged"] == 2
+    assert _merge_load_summaries([None, None]) is None
+
+
+# ---------------------------------------------------------------------------
+# config surfaces
+# ---------------------------------------------------------------------------
+
+def test_broker_config_validate_rejects_bad_marks():
+    with pytest.raises(ValueError):
+        BrokerConfig(p99_low_ms=500.0, p99_high_ms=100.0).validate()
+    with pytest.raises(ValueError):
+        BrokerConfig(min_serve_replicas=3,
+                     max_serve_replicas=1).validate()
+    with pytest.raises(ValueError):
+        BrokerConfig(min_train_workers=0).validate()
+    BrokerConfig().validate()  # defaults are coherent
+
+
+def test_chaos_config_broker_validation():
+    with pytest.raises(ClusterError):
+        ChaosConfig(payload="shell", broker=True)
+    with pytest.raises(ClusterError):
+        ChaosConfig(payload="serving", broker=True,
+                    broker_train_workers=1)
+    with pytest.raises(ClusterError):
+        ChaosConfig(payload="serving", broker=True,
+                    serve_precision_tiers=("int8",))
+
+
+def test_chaos_config_broker_roster_adds_donor_trainers():
+    cfg = ChaosConfig(payload="serving", broker=True, serve_replicas=2,
+                      broker_train_workers=3, until_step=24)
+    assert cfg.trial_num_workers() == 1 + 2 + 2
+    cmds = cfg.resolved_worker_commands()
+    serve = cfg.resolved_serve_command()
+    assert cmds["1"] == serve and cmds["2"] == serve
+    # donors run the publisher payload with a 10x step budget so they
+    # never finish inside the trial window
+    assert "train.max_steps=240" in cmds["3"]
+    assert cmds["3"] == cmds["4"] != serve
+    # non-broker rosters are unchanged by the new knobs
+    plain = ChaosConfig(payload="serving", serve_replicas=2)
+    assert plain.trial_num_workers() == 3
+    assert set(plain.resolved_worker_commands()) == {"1", "2"}

@@ -17,7 +17,8 @@ from distributedmnist_tpu.launch.chaos import (_SHELL_PAYLOAD, ChaosCampaign,
                                                ChaosConfig, ChaosFault,
                                                ChaosSchedule,
                                                generate_schedule)
-from distributedmnist_tpu.launch.cluster import (LocalClusterConfig,
+from distributedmnist_tpu.launch.cluster import (ClusterError,
+                                                 LocalClusterConfig,
                                                  LocalProcessCluster)
 from distributedmnist_tpu.launch.exec import (CommandExecutor, FaultPlan,
                                               RetryPolicy)
@@ -245,6 +246,26 @@ def test_drain_closes_open_mttr_episode(tmp_path):
 # ---------------------------------------------------------------------------
 # adaptive stall timeout: derived from the measured boot, not hardcoded
 # ---------------------------------------------------------------------------
+
+def test_chaos_config_from_file_accepts_inline_json(tmp_path):
+    # `--chaos-config` takes a file path OR inline JSON (every recipe in
+    # verify SKILL.md uses the inline form) — both must parse identically.
+    p = tmp_path / "c.json"
+    p.write_text('{"seed": 9, "serve_fault_window": [3, 20]}')
+    from_path = ChaosConfig.from_file(p)
+    inline = ChaosConfig.from_file('{"seed": 9, "serve_fault_window": [3, 20]}')
+    assert inline == from_path
+    assert inline.seed == 9 and inline.serve_fault_window == (3, 20)
+    with pytest.raises(ClusterError):
+        ChaosConfig.from_file('{"not_a_knob": 1}')
+    # CLI flag overrides merge BEFORE construction: a JSON arming
+    # broker relies on `--payload serving` to satisfy __post_init__'s
+    # cross-field check (a post-hoc replace() would raise at build)
+    cfg = ChaosConfig.from_file(
+        '{"broker": true, "broker_train_workers": 2}',
+        overrides={"payload": "serving", "seed": 3})
+    assert cfg.broker and cfg.payload == "serving" and cfg.seed == 3
+
 
 def test_stall_timeout_derives_from_measured_boot():
     cfg = ChaosConfig()
