@@ -57,7 +57,7 @@ def main(argv: list[str] | None = None) -> int:
     names = (set(args.checkers.split(",")) if args.checkers else None)
     if names is not None:
         from . import (config_check, jax_check,  # noqa: F401
-                       schema_check, threads_check)
+                       paged_check, schema_check, threads_check)
         unknown = names - set(CHECKERS)
         if unknown:
             ap.error(f"unknown checker(s): "

@@ -540,6 +540,22 @@ class ServeConfig:
       ``model.compute_dtype``). Resolved through the shared
       ``effective_model_config`` seam so serving can run cheaper
       numerics than training without forking the model section.
+    * ``tp_ranks`` — tensor-parallel replica width. 1 (default) keeps
+      the historical single-chip replica. > 1 makes replica capacity a
+      MESH SHAPE: the replica builds a ``(replica=1, model=tp_ranks)``
+      serving mesh, sharded-loads each published checkpoint through
+      the model's TP partition rules (``restore_for_topology``), and
+      serves through GSPMD-partitioned compute — behind the UNCHANGED
+      socket/failover/hot-swap/heartbeat contract. Launched as a
+      process group (``launch serve --tp-ranks N``): rank 0 owns the
+      socket, mesh, and serve.json; non-zero ranks are followers that
+      digest-verify their weight shard per publish; the supervisor
+      enforces die-as-a-unit (any rank exit kills and restarts the
+      whole group — a half-dead TP group never serves). See
+      ``servesvc/tp_group.py``.
+    * ``tp_group_max_restarts`` / ``tp_group_poll_secs`` — group
+      supervisor knobs: bounded whole-group restarts after a rank
+      death, and the child-liveness poll cadence.
     """
 
     host: str = "127.0.0.1"
@@ -551,6 +567,9 @@ class ServeConfig:
     default_deadline_ms: float = 2000.0
     precision_tier: str = "fp32"   # fp32 | bf16 | int8
     compute_dtype: str = ""        # "" → precision/model resolution
+    tp_ranks: int = 1              # >1 = tensor-parallel serving group
+    tp_group_max_restarts: int = 3
+    tp_group_poll_secs: float = 0.25
 
 
 # The serving-tier grammar: what ``serve.precision_tier`` accepts, and
@@ -560,6 +579,10 @@ QUANT_TIERS = ("bf16", "int8")
 
 # Mid-generation weight-swap disciplines for the decode service.
 DECODE_SWAP_POLICIES = ("pin", "restart")
+
+# Cache-read implementations for the decode step: the dense full-table
+# gather (the oracle) and the fused Pallas paged-attention kernel.
+DECODE_ATTENTION_KERNELS = ("dense", "paged")
 
 
 @dataclass(frozen=True)
@@ -599,6 +622,14 @@ class DecodeConfig:
       the causal license the ``decode_swap`` replay invariant
       requires whenever a sequence finishes on a different step than
       it started on).
+    * ``attention_kernel`` — how the decode step reads the paged
+      cache: ``"dense"`` (default) gathers each slot's full block
+      table into a dense [slots, max_context, h, hd] view before
+      attending — O(max context) traffic per token, and the oracle
+      the parity tests pin; ``"paged"`` runs the fused Pallas kernel
+      (``ops/pallas_paged_attention.py``) that walks the table
+      in-kernel — O(actual context) per token. Numerics are pinned
+      equal for live slots (tests/test_paged_attention.py).
     """
 
     decode_slots: int = 4
@@ -610,11 +641,17 @@ class DecodeConfig:
     temperature: float = 0.0
     top_k: int = 0
     swap_policy: str = "pin"
+    attention_kernel: str = "dense"  # dense | paged
 
     def validate(self) -> None:
         """Build-time validation (DecodeReplica construction): a bad
         knob is a typed ConfigError naming the constraint, not a shape
         error mid-generation."""
+        if self.attention_kernel not in DECODE_ATTENTION_KERNELS:
+            raise ConfigError(
+                f"decode.attention_kernel={self.attention_kernel!r} is "
+                f"not a known kernel; valid kernels: "
+                f"{', '.join(DECODE_ATTENTION_KERNELS)}")
         if self.swap_policy not in DECODE_SWAP_POLICIES:
             raise ConfigError(
                 f"decode.swap_policy={self.swap_policy!r} is not a "

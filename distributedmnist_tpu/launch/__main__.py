@@ -159,17 +159,37 @@ def _serve(args) -> None:
     activation = os.environ.get("DMT_STANDBY_ACTIVATION")
     if activation:
         args.serve_dir = _park_serve_standby(activation)
+    tp_ranks = (args.tp_ranks if args.tp_ranks is not None
+                else cfg.serve.tp_ranks)
+    if tp_ranks > 1 and args.tp_rank is None:
+        # TP group supervisor: re-invoke this very command once per
+        # rank (rank 0 = the real replica owning the socket, ranks>0 =
+        # shard-verifying followers) and babysit them die-as-a-unit
+        import sys
+
+        from ..servesvc.tp_group import ServeGroup, default_spawn_fn
+        spawn = default_spawn_fn(sys.argv[1:], args.serve_dir, tp_ranks)
+        ServeGroup(args.serve_dir, tp_ranks, spawn,
+                   max_restarts=cfg.serve.tp_group_max_restarts,
+                   poll_secs=cfg.serve.tp_group_poll_secs).run_forever()
+        return
+    if args.tp_rank is not None and args.tp_rank > 0:
+        from ..servesvc.tp_group import run_rank_follower
+        run_rank_follower(args.train_dir, args.serve_dir, args.tp_rank,
+                          tp_ranks,
+                          poll_secs=cfg.serve.tp_group_poll_secs)
+        return
     overrides = {k: getattr(args, k) for k in
                  ("host", "port", "max_batch", "queue_depth",
                   "batch_window_ms", "poll_secs", "default_deadline_ms",
-                  "precision_tier", "compute_dtype")
+                  "precision_tier", "compute_dtype", "tp_ranks")
                  if getattr(args, k) is not None}
     scfg = dataclasses.replace(cfg.serve, **overrides)
     if args.decode:
         from ..servesvc.decode import DecodeReplica
         d_over = {k: getattr(args, k) for k in
                   ("decode_slots", "max_new_tokens", "max_prompt_len",
-                   "swap_policy")
+                   "swap_policy", "attention_kernel")
                   if getattr(args, k) is not None}
         dcfg = dataclasses.replace(cfg.decode, **d_over)
         DecodeReplica(args.train_dir, serve_dir=args.serve_dir,
@@ -551,6 +571,23 @@ def main(argv=None) -> None:
                     help="pin | restart — what a weight hot-swap does "
                          "to sequences mid-generation "
                          "(decode.swap_policy)")
+    pv.add_argument("--attention-kernel", default=None,
+                    dest="attention_kernel",
+                    help="dense | paged — decode attention path "
+                         "(decode.attention_kernel); paged walks each "
+                         "slot's block table in-kernel, O(actual "
+                         "context) per token")
+    pv.add_argument("--tp-ranks", type=int, default=None,
+                    dest="tp_ranks",
+                    help="boot the replica as an N-rank tensor-"
+                         "parallel process group (serve.tp_ranks): "
+                         "rank 0 owns the socket and the sharded "
+                         "serving mesh, other ranks shard-verify "
+                         "every publish; any rank dying takes the "
+                         "whole group down for a unit restart")
+    pv.add_argument("--tp-rank", type=int, default=None, dest="tp_rank",
+                    help=argparse.SUPPRESS)  # internal: set by the
+    # group supervisor when re-invoking serve per rank
     pv.set_defaults(fn=_serve)
 
     pl = sub.add_parser(

@@ -454,6 +454,11 @@ class ChaosConfig:
     decode_max_new_tokens: int = 16
     decode_max_prompt_len: int = 16
     decode_slots: int = 4
+    # boot every serving replica as an N-rank tensor-parallel process
+    # group (serve.tp_ranks): the kill-worker faults then hit a group
+    # supervisor whose die-as-a-unit restart the serve_group invariant
+    # replays — a half-dead TP group must never serve
+    serve_tp_ranks: int = 1
     # -- resource broker (serving mode only) ------------------------------
     # broker=true arms demand-driven autoscaling (launch/broker.py)
     # over the trial's roster: DONOR train workers join it
@@ -691,6 +696,8 @@ class ChaosConfig:
             cmd += (f" --decode --decode-slots {self.decode_slots}"
                     f" --max-new-tokens {self.decode_max_new_tokens}"
                     f" --max-prompt-len {self.decode_max_prompt_len}")
+        if self.serve_tp_ranks > 1:
+            cmd += f" --tp-ranks {self.serve_tp_ranks}"
         return cmd
 
     def resolved_donor_command(self,

@@ -544,11 +544,13 @@ def _transformer(cfg: ModelConfig) -> Model:
                 compute_dtype=compute_dtype)
 
         def decode_step_fn(params, tokens, positions, k_cache, v_cache,
-                           block_tables, lengths, *, block_size):
+                           block_tables, lengths, *, block_size,
+                           attention_kernel="dense"):
             return transformer.decode_step(
                 params, tokens, positions, k_cache, v_cache,
                 block_tables, lengths, num_heads=cfg.num_heads,
-                block_size=block_size, compute_dtype=compute_dtype)
+                block_size=block_size, compute_dtype=compute_dtype,
+                attention_kernel=attention_kernel)
 
         decode_cache_shape = (cfg.num_layers, cfg.num_heads,
                               cfg.model_dim // cfg.num_heads)

@@ -340,7 +340,8 @@ def decode_step(params: Params, tokens: jax.Array, positions: jax.Array,
                 k_cache: jax.Array, v_cache: jax.Array,
                 block_tables: jax.Array, lengths: jax.Array, *,
                 num_heads: int = 4, block_size: int = 16,
-                compute_dtype=jnp.bfloat16
+                compute_dtype=jnp.bfloat16,
+                attention_kernel: str = "dense"
                 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One incremental decode step over S slots sharing one paged KV
     cache — the single compiled shape every in-flight sequence runs
@@ -356,11 +357,23 @@ def decode_step(params: Params, tokens: jax.Array, positions: jax.Array,
       (``positions + 1``; 0 for idle slots, whose rows compute masked
       garbage the caller ignores).
 
+    ``attention_kernel`` selects the cache read: ``"dense"`` gathers
+    every table entry into a [S, max_context, h, hd] view (the oracle
+    path — O(max context) traffic per token), ``"paged"`` runs the
+    fused Pallas kernel that walks the table in-kernel (O(actual
+    context); see ops/pallas_paged_attention.py). Both share the
+    pinned numerics below; parity across them is tested in
+    tests/test_paged_attention.py.
+
     Returns (logits [S, vocab] float32, k_cache, v_cache) with this
     token's K/V written at its block/offset. Attention numerics match
     ``local_self_attention`` (f32 scores/softmax, 1/sqrt(hd) scale),
     so greedy decode through the cache reproduces the full-context
     forward (pinned in tests/test_decode.py)."""
+    if attention_kernel not in ("dense", "paged"):
+        raise ValueError(
+            f"decode.attention_kernel must be 'dense' or 'paged', "
+            f"got {attention_kernel!r}")
     p = jax.tree.map(lambda a: a.astype(compute_dtype), params)
     num_slots = tokens.shape[0]
     x = p["embed"][tokens] + p["pos"][positions]  # [S, d]
@@ -383,19 +396,26 @@ def decode_step(params: Params, tokens: jax.Array, positions: jax.Array,
             kh.astype(k_cache.dtype))
         v_cache = v_cache.at[li, blk_ids, offs].set(
             vh.astype(v_cache.dtype))
-        # gather the slot's pages into one dense context view: the
-        # block table IS the indirection, so this read is identical
-        # for a 3-token and a 90-token sequence — one compiled shape
-        kp = k_cache[li][block_tables].reshape(
-            num_slots, ctx, num_heads, hd)
-        vp = v_cache[li][block_tables].reshape(
-            num_slots, ctx, num_heads, hd)
         qh = q.reshape(num_slots, num_heads, hd)
-        scores = jnp.einsum("shd,skhd->shk", qh.astype(jnp.float32),
-                            kp.astype(jnp.float32)) * scale
-        scores = jnp.where(live[:, None, :], scores, _DECODE_NEG)
-        w = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum("shk,skhd->shd", w, vp.astype(jnp.float32))
+        if attention_kernel == "paged":
+            # fused path: the kernel walks the block table itself, so
+            # per-token traffic is O(actual context) — no dense view
+            from ..ops.pallas_paged_attention import paged_attention
+            o = paged_attention(qh, k_cache[li], v_cache[li],
+                                block_tables, lengths, scale=scale)
+        else:
+            # gather the slot's pages into one dense context view: the
+            # block table IS the indirection, so this read is identical
+            # for a 3-token and a 90-token sequence — one compiled shape
+            kp = k_cache[li][block_tables].reshape(
+                num_slots, ctx, num_heads, hd)
+            vp = v_cache[li][block_tables].reshape(
+                num_slots, ctx, num_heads, hd)
+            scores = jnp.einsum("shd,skhd->shk", qh.astype(jnp.float32),
+                                kp.astype(jnp.float32)) * scale
+            scores = jnp.where(live[:, None, :], scores, _DECODE_NEG)
+            w = jax.nn.softmax(scores, axis=-1)
+            o = jnp.einsum("shk,skhd->shd", w, vp.astype(jnp.float32))
         o = o.astype(compute_dtype).reshape(num_slots, d)
         x = x + o @ blk["wo"]
         x, _ = _ffn_sublayer(x, blk, model_axis=None)

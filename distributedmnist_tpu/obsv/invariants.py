@@ -75,7 +75,7 @@ from .report import load_jsonl
 INVARIANTS = ("terminal_state", "metrics_log", "determinism",
               "causality", "checkpoint_integrity", "reconfigure",
               "serve_outcomes", "serve_digest", "serve_monotone",
-              "decode_swap", "autoscale")
+              "decode_swap", "serve_group", "autoscale")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -777,6 +777,67 @@ def check_serving(trial_dir: str | Path, outcome: dict,
     return out, True, serve_workers, decode_applicable
 
 
+def check_serve_group(trial_dir: str | Path
+                      ) -> tuple[list[Violation], bool]:
+    """**serve_group** — die-as-a-unit for tensor-parallel serving
+    groups (servesvc/tp_group.py), replayed from ``group_log.jsonl``.
+
+    A TP replica is one process group; a group missing a rank holds
+    only part of every sharded weight, so it must NEVER keep (or
+    resume) serving half-dead.  The supervisor's journal chain makes
+    that checkable: every ``rank_exit`` must be answered by a
+    ``group_down`` (all surviving ranks killed) before any later
+    ``group_start`` (the unit restart), and restart ``attempt``
+    numbers only move forward — a supervisor looping without
+    acknowledging teardown is exactly the bug this invariant exists
+    to catch.  Applicable only to workers that left a group journal;
+    returns ``(violations, applicable)``."""
+    trial_dir = Path(trial_dir)
+    out: list[Violation] = []
+    applicable = False
+    for k, d in sorted(_worker_dirs(trial_dir).items()):
+        glog = d / "group_log.jsonl"
+        if not glog.exists():
+            continue
+        applicable = True
+        recs = load_jsonl(glog, schema.SERVE)
+        pending_exit: Any = None   # rank of an unanswered rank_exit
+        started = False
+        last_attempt = -1
+        for r in recs:
+            a = r.get("action")
+            if a == "group_start":
+                if pending_exit is not None:
+                    out.append(Violation(
+                        "serve_group",
+                        f"group restarted after rank {pending_exit} "
+                        "exited with no group_down in between — a "
+                        "half-dead TP group was never torn down as a "
+                        "unit", k))
+                    pending_exit = None
+                att = r.get("attempt")
+                if isinstance(att, int):
+                    if started and att <= last_attempt:
+                        out.append(Violation(
+                            "serve_group",
+                            f"group_start attempt went backwards "
+                            f"({last_attempt} -> {att}) — the restart "
+                            "budget scan is meaningless", k))
+                    last_attempt = att
+                started = True
+            elif a == "rank_exit":
+                pending_exit = r.get("rank")
+            elif a == "group_down":
+                pending_exit = None
+        if pending_exit is not None:
+            out.append(Violation(
+                "serve_group",
+                f"rank {pending_exit} exited and no group_down ever "
+                "followed — the group may have kept serving with a "
+                "missing shard", k))
+    return out, applicable
+
+
 # ---------------------------------------------------------------------------
 # whole-run replay
 # ---------------------------------------------------------------------------
@@ -865,6 +926,12 @@ def check_run(trial_dir: str | Path, outcome: dict | None = None,
         # only trials whose replicas ran the decode workload make the
         # swap-during-generation claim
         skipped.add("decode_swap")
+    group_violations, group_applicable = check_serve_group(trial_dir)
+    violations += group_violations
+    if not group_applicable:
+        # only trials that booted a TP serving process group (a worker
+        # left a group_log.jsonl) make the die-as-a-unit claim
+        skipped.add("serve_group")
     autoscale_violations, autoscale_applicable = check_autoscale(
         outcome, journal_all)
     violations += autoscale_violations

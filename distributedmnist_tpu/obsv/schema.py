@@ -178,10 +178,15 @@ _declare(EventSchema(
 
 # Serving-replica journal (servesvc/server.py serve_log.jsonl).  The
 # ``follow_*`` actions are the checkpoint follower's restore events
-# re-journaled with their serve-side prefix.
+# re-journaled with their serve-side prefix.  The group lifecycle
+# actions (``group_*`` / ``rank_*`` / ``shard_verify``) are the TP
+# serving group's journal (servesvc/tp_group.py): the supervisor
+# writes them to ``group_log.jsonl`` and follower ranks stamp every
+# record with their ``rank`` — hence the top-level optional.
 _declare(EventSchema(
     SERVE,
     required=("action",),
+    optional=("rank",),
     actions={
         "serve_start": _act(("port", "model_step", "precision_tier",
                              "active_tier", "queue_depth", "max_batch")),
@@ -216,6 +221,21 @@ _declare(EventSchema(
         "follow_fallback_restore": _act(("step",)),
         "follow_cross_world_restore": _act(("step", "saved_world",
                                             "new_world")),
+        # -- TP serving group lifecycle (servesvc/tp_group.py) ---------
+        # die-as-a-unit is a CHECKED chain: every unexpected
+        # ``rank_exit`` must be followed by a ``group_down`` before the
+        # next ``group_start`` (the ``serve_group`` invariant) — a TP
+        # replica missing a shard must never keep serving.
+        "group_start": _act(("ranks", "attempt")),
+        "rank_spawn": _act(("rank", "pid")),
+        "rank_exit": _act(("rank", "pid", "rc")),
+        "group_down": _act(("reason", "ranks"), ("rank",)),
+        "group_restart": _act(("attempt", "backoff_s")),
+        "group_stop": _act(("ranks",)),
+        # follower ranks: sha256 of THIS rank's model-axis param shard
+        # per verified publish — the shard-wise hot-swap evidence
+        "shard_verify": _act(("rank", "step", "digest"),
+                             ("source_digest",)),
     },
 ))
 
@@ -255,7 +275,7 @@ _declare(EventSchema(
 _declare(EventSchema(
     HEARTBEAT,
     required=("step",),
-    optional=("queue_depth", "queue_limit", "kv_blocks_free",
+    optional=("tp_rank", "queue_depth", "queue_limit", "kv_blocks_free",
               "kv_blocks_total", "kv_blocks_reserved",
               "decode_waiting"),
 ))

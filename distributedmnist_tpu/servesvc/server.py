@@ -115,6 +115,7 @@ class ServingReplica:
             cfg = wait_for_run_config(self.train_dir)
         self.cfg = cfg
         self.scfg = scfg or cfg.serve
+        self.tp_ranks = max(1, int(self.scfg.tp_ranks))
         if topo is not None:
             self.topo = topo
         else:
@@ -126,8 +127,23 @@ class ServingReplica:
                 raise ValueError(
                     "serving cannot restore pipeline-stacked parameter "
                     "layouts; serve from a non-pipeline checkpoint")
-            self.topo = make_topology(MeshConfig(num_replicas=1),
-                                      devices=jax.devices()[:1])
+            if self.tp_ranks > 1:
+                # TP serving: replica capacity as a mesh shape. One
+                # replica axis × tp_ranks model axis; every published
+                # checkpoint is sharded-loaded through the model's TP
+                # partition rules (restore_for_topology below) and the
+                # jitted predict/decode runs GSPMD-partitioned over
+                # the serving mesh. On hosts with fewer devices than
+                # ranks the mesh is simulated (virtual CPU devices) —
+                # the sharded-load/swap/verify contract is identical.
+                self.topo = make_topology(MeshConfig(
+                    num_replicas=1, model_parallelism=self.tp_ranks,
+                    simulate_devices=(0 if len(jax.devices())
+                                      >= self.tp_ranks
+                                      else self.tp_ranks)))
+            else:
+                self.topo = make_topology(MeshConfig(num_replicas=1),
+                                          devices=jax.devices()[:1])
         # serve-side compute-dtype resolution (serve.compute_dtype →
         # precision.compute_dtype → model.compute_dtype), validated at
         # the shared seam — a typo is a typed ConfigError here, not a
@@ -139,9 +155,16 @@ class ServingReplica:
                 f"serve.precision_tier={self.tier!r} is not a known "
                 f"tier; valid tiers: "
                 f"{', '.join(SERVING_PRECISION_TIERS)}")
-        self.template = init_train_state(self.model, cfg, self.topo)
-        self._param_specs = state_partition_specs(
-            self.model, cfg, self.topo).params
+        try:
+            self.template = init_train_state(self.model, cfg, self.topo)
+            self._param_specs = state_partition_specs(
+                self.model, cfg, self.topo).params
+        except ValueError as e:
+            if self.tp_ranks > 1:
+                raise ConfigError(
+                    f"serve.tp_ranks={self.tp_ranks} needs a model with "
+                    f"tensor-parallel partition rules: {e}") from e
+            raise
         self.follower = ckpt.CheckpointFollower(self.train_dir)
 
         model = self.model
@@ -309,12 +332,24 @@ class ServingReplica:
             if got is not None:
                 return got
             # journaled fallback: this publish serves full precision
-        restored = ckpt.restore_checkpoint(
-            self.train_dir, self.template, None,
-            on_event=lambda rec: self._journal(
-                {"action": "follow_" + rec.get("action", "?"),
-                 **{k: v for k, v in rec.items()
-                    if k not in ("layer", "action")}}))
+        on_event = lambda rec: self._journal(
+            {"action": "follow_" + rec.get("action", "?"),
+             **{k: v for k, v in rec.items()
+                if k not in ("layer", "action")}})
+        if self.tp_ranks > 1:
+            # TP replica: the mesh-portable restore — the checkpoint
+            # was saved under the TRAINER's world, and every rank of
+            # this serving mesh takes only its shard of each leaf when
+            # device_put_state places the result over the TP specs
+            # below (restore journals follow_cross_world_restore when
+            # the worlds differ)
+            from ..parallel.api import restore_for_topology
+            restored = restore_for_topology(
+                self.model, self.cfg, self.topo, self.train_dir,
+                self.template, on_event=on_event)
+        else:
+            restored = ckpt.restore_checkpoint(
+                self.train_dir, self.template, None, on_event=on_event)
         if restored is None:
             return None
         state, _, at_step = restored

@@ -143,6 +143,76 @@ class TestConfigChecker:
         dead = sorted(k for k in got if ":dead." in k)
         assert dead == [], f"declared-but-unread knobs: {dead}"
 
+    def test_audit_covers_paged_and_tp_knobs(self, config_source):
+        """The PR-17 knobs are declared AND genuinely consumed — the
+        audit must neither dead-flag them on the real tree nor accept
+        a typo'd read of them."""
+        srcs = iter_sources([PKG], repo_root=REPO)
+        got = keys(config_check.check(srcs))
+        for knob in ("decode.attention_kernel", "serve.tp_ranks",
+                     "serve.tp_group_max_restarts",
+                     "serve.tp_group_poll_secs"):
+            assert not any(f"dead.{knob}" in k for k in got), knob
+        bad = src("distributedmnist_tpu/servesvc/snippet.py",
+                  "def f(cfg):\n    return cfg.serve.tp_rankz\n")
+        got = keys(config_check.check([config_source, bad]))
+        assert any("unknown.serve.tp_rankz" in k for k in got)
+
+
+# ---------------------------------------------------------------------------
+# paged checker fixtures (dense-materialization lint, servesvc/ scope)
+# ---------------------------------------------------------------------------
+
+class TestPagedChecker:
+    def check(self, text: str,
+              path: str = "distributedmnist_tpu/servesvc/snippet.py"):
+        from distributedmnist_tpu.analysis import paged_check
+        return paged_check.check([src(path, text)])
+
+    def test_dense_gather_in_hot_function_flagged(self):
+        got = self.check(
+            "def _step_active(self):\n"
+            "    ks, vs = self.cache.gather_dense(table, length)\n")
+        assert any("dense-gather._step_active.gather_dense" in k
+                   for k in keys(got))
+
+    def test_table_rebuild_in_hot_loop_flagged(self):
+        got = self.check(
+            "def _step_active(self):\n"
+            "    for s in self._slots:\n"
+            "        tables = np.zeros((n, width))\n")
+        assert any("table-rebuild._step_active.zeros" in k
+                   for k in keys(got))
+
+    def test_cached_rebuild_outside_loop_clean(self):
+        # the epoch-keyed cache shape: built once per composition
+        # change, OUTSIDE any loop — exactly what decode.py does now
+        got = self.check(
+            "def _tables_for(self, version):\n"
+            "    tables = np.zeros((n, width))\n"
+            "    return tables\n")
+        assert got == []
+
+    def test_cold_path_and_other_trees_exempt(self):
+        hot = ("def decode_step(self):\n"
+               "    ks = gather_dense(table, n)\n")
+        # same text outside servesvc/ (the dense oracle lives in
+        # models/ and tests/) is out of scope by design
+        assert self.check(
+            hot, path="distributedmnist_tpu/models/transformer.py") == []
+        assert self.check(hot, path="tests/test_x.py") == []
+        # non-hot function names in servesvc are fine too (setup /
+        # oracle helpers)
+        got = self.check("def _debug_dump(self):\n"
+                         "    ks = gather_dense(table, n)\n")
+        assert got == []
+
+    def test_real_servesvc_tree_is_clean(self):
+        from distributedmnist_tpu.analysis import paged_check
+        srcs = iter_sources([PKG / "servesvc"], repo_root=REPO)
+        got = paged_check.check(srcs)
+        assert got == [], [f.key for f in got]
+
 
 # ---------------------------------------------------------------------------
 # concurrency checker fixtures
@@ -504,7 +574,8 @@ class TestSelfCheck:
 
     def test_all_checkers_registered(self):
         run_checkers([])  # force registration imports
-        assert set(CHECKERS) == {"schema", "config", "threads", "jax"}
+        assert set(CHECKERS) == {"schema", "config", "threads", "jax",
+                                 "paged"}
 
     def test_baseline_entries_carry_justifications(self):
         raw = json.loads(
