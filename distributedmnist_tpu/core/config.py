@@ -236,6 +236,96 @@ class SyncConfig:
     # work, asymmetric host feeds. Off by default (one probe dispatch +
     # readiness poll per local replica per step).
     measure_device_skew: bool = False
+    # -- adaptive straggler discipline (train/discipline.py) -----------
+    # The online controller: watch the rolling per-replica step-time
+    # CDF and adapt the discipline parameters (quorum k / timeout_ms)
+    # at runtime — they are traced step inputs (parallel/api.py
+    # make_discipline_vector), so a change swaps a scalar buffer, not a
+    # compiled executable. Decision rule (pure, journal-licensed, the
+    # broker decide() shape): when the window tail ratio p99/p50
+    # crosses ``adaptive_tail_high`` the discipline TIGHTENS (quorum:
+    # k−1 down to ceil(n·min_quorum_frac); timeout: deadline →
+    # max(floor, p50·timeout_factor)); when it falls back under
+    # ``adaptive_tail_low`` it RELAXES one notch toward the configured
+    # static setting. Dead band between the marks, cooldown in steps
+    # from the last completed change. Every change is journaled as an
+    # event:"discipline" begin/complete pair and licensed by the
+    # recorded crossing (obsv/invariants.py "discipline").
+    adaptive: bool = False
+    adaptive_window_steps: int = 20    # rolling CDF window (steps)
+    adaptive_cooldown_steps: int = 40  # min steps between changes
+    adaptive_tail_high: float = 2.0    # p99/p50 tighten mark
+    adaptive_tail_low: float = 1.3    # p99/p50 relax mark (< high)
+    adaptive_min_quorum_frac: float = 0.5   # quorum floor: ceil(n·frac)
+    adaptive_timeout_factor: float = 1.5    # tightened deadline = p50·this
+    adaptive_timeout_floor_ms: float = 1.0  # deadline never below this
+
+    def validate(self, num_replicas: int | None = None) -> None:
+        """Typed knob validation (ConfigError, the OptimConfig pattern)
+        — called from ``build_train_step``, so every Trainer build hits
+        it before any tracing. Base knobs stay permissive (timeout_ms=0
+        legitimately masks every replica — pinned in tests); the
+        ``adaptive`` family is strict."""
+        if not (self.straggler_sigma >= 0.0):
+            raise ConfigError(
+                f"sync.straggler_sigma must be >= 0, got "
+                f"{self.straggler_sigma}")
+        if not (0.0 <= self.straggler_spike_prob <= 1.0):
+            raise ConfigError(
+                f"sync.straggler_spike_prob must be in [0, 1], got "
+                f"{self.straggler_spike_prob}")
+        if not self.adaptive:
+            return
+        if self.mode not in ("quorum", "timeout"):
+            raise ConfigError(
+                f"sync.adaptive=true requires a maskable mode "
+                f"(quorum | timeout), got mode={self.mode!r} — sync/cdf "
+                "have no straggler parameter to adapt, and interval "
+                "pacing adapts the modeled wall clock only, not which "
+                "replicas contribute")
+        if self.adaptive_window_steps < 2:
+            raise ConfigError(
+                f"sync.adaptive_window_steps must be >= 2 (a one-sample "
+                f"window has no CDF), got {self.adaptive_window_steps}")
+        if self.adaptive_cooldown_steps < self.adaptive_window_steps:
+            raise ConfigError(
+                f"sync.adaptive_cooldown_steps "
+                f"({self.adaptive_cooldown_steps}) must be >= "
+                f"adaptive_window_steps ({self.adaptive_window_steps}) — "
+                "a cooldown shorter than the window re-decides on "
+                "samples from before the last change")
+        if not (self.adaptive_tail_high > self.adaptive_tail_low >= 1.0):
+            raise ConfigError(
+                f"sync.adaptive tail marks need high > low >= 1.0 "
+                f"(hysteresis needs a dead band; p99/p50 is >= 1 by "
+                f"construction), got high={self.adaptive_tail_high} "
+                f"low={self.adaptive_tail_low}")
+        if not (0.0 < self.adaptive_min_quorum_frac <= 1.0):
+            raise ConfigError(
+                f"sync.adaptive_min_quorum_frac must be in (0, 1], got "
+                f"{self.adaptive_min_quorum_frac}")
+        if not (self.adaptive_timeout_factor >= 1.0):
+            raise ConfigError(
+                f"sync.adaptive_timeout_factor must be >= 1.0 (a "
+                f"deadline under the window median masks the majority), "
+                f"got {self.adaptive_timeout_factor}")
+        if not (self.adaptive_timeout_floor_ms > 0.0):
+            raise ConfigError(
+                f"sync.adaptive_timeout_floor_ms must be > 0, got "
+                f"{self.adaptive_timeout_floor_ms}")
+        if num_replicas is not None and self.mode == "quorum":
+            import math
+            k_floor = max(1, math.ceil(num_replicas
+                                       * self.adaptive_min_quorum_frac))
+            k0 = (num_replicas if self.num_replicas_to_aggregate == -1
+                  else self.num_replicas_to_aggregate)
+            if k0 < k_floor:
+                raise ConfigError(
+                    f"sync.num_replicas_to_aggregate={k0} starts below "
+                    f"the adaptive quorum floor ceil({num_replicas} * "
+                    f"{self.adaptive_min_quorum_frac}) = {k_floor} — the "
+                    "controller could never relax back to the "
+                    "configured setting")
 
 
 @dataclass(frozen=True)

@@ -480,6 +480,23 @@ class ChaosConfig:
     broker_phase_secs: float = 10.0
     broker_window_s: float = 3.0    # loadgen rolling-window width
     broker_config: dict | None = None  # BrokerConfig field overrides
+    # -- straggler-discipline controller (train payload only) -------------
+    # discipline_controller=true arms the adaptive straggler-discipline
+    # controller (train/discipline.py) inside every train worker: the
+    # payload runs quorum aggregation over a seeded synthetic SPIKE
+    # straggler profile, so the per-window tail ratio the controller
+    # reads derives from the run seed alone — trial and reference make
+    # IDENTICAL decisions, the discipline traces match, and invariant 3
+    # keeps its full bitwise claim (a mid-run restart resets the
+    # controller's in-memory state, diverges the trace, and exercises
+    # the epoch-splice path instead). Every parameter change must
+    # replay against the "discipline" invariant — the campaign's gate
+    # is at least one licensed change with zero flaps.
+    discipline_controller: bool = False
+    discipline_window_steps: int = 8
+    discipline_cooldown_steps: int = 8
+    discipline_spike_prob: float = 0.25
+    discipline_spike_scale: float = 8.0
     # schedule intensity
     max_faults: int = 3
     min_faults: int = 1
@@ -565,6 +582,19 @@ class ChaosConfig:
                     "the publisher is never a scale-up victim, so at "
                     "least one donor trainer must exist for the broker "
                     "to trade")
+        if self.discipline_controller:
+            if self.payload != "train":
+                raise ClusterError(
+                    "discipline_controller=true requires payload=train: "
+                    "the straggler-discipline controller lives in the "
+                    "training step (quorum over a synthetic straggler "
+                    "profile), not the shell or serving payloads")
+            if self.train_command:
+                raise ClusterError(
+                    "discipline_controller=true is incompatible with a "
+                    "train_command override: the controller knobs are "
+                    "appended to the built-in train payload, and a "
+                    "custom command owns its own sync.* flags")
 
     @classmethod
     def from_file(cls, path: str | Path,
@@ -675,8 +705,22 @@ class ChaosConfig:
                 # sidecars never touch the train state)
                 cmd += f" quant.publish_tiers={','.join(quant)}"
             return cmd
-        return _TRAIN_PAYLOAD.format(max_steps=self.until_step,
-                                     save=self.save_interval_steps)
+        cmd = _TRAIN_PAYLOAD.format(max_steps=self.until_step,
+                                    save=self.save_interval_steps)
+        if self.discipline_controller:
+            # quorum over the seeded synthetic spike profile: the
+            # controller's CDF signal derives from the run seed alone,
+            # so the fault-free reference adapts identically and the
+            # bitwise determinism claim survives the armed controller
+            cmd += (
+                " sync.mode=quorum sync.adaptive=true"
+                f" sync.adaptive_window_steps={self.discipline_window_steps}"
+                f" sync.adaptive_cooldown_steps="
+                f"{self.discipline_cooldown_steps}"
+                " sync.straggler_profile=spike"
+                f" sync.straggler_spike_prob={self.discipline_spike_prob}"
+                f" sync.straggler_spike_scale={self.discipline_spike_scale}")
+        return cmd
 
     def resolved_quant_publish_tiers(self) -> tuple[str, ...]:
         """The distinct non-fp32 tiers any replica serves — what the
@@ -957,6 +1001,17 @@ class ChaosCampaign:
                 serve_recs += load_jsonl(
                     lcfg.worker_dir(k) / "serve_log.jsonl", "serve")
             outcome["serve_swaps"] = summarize_serving_swaps(serve_recs)
+        if cfg.discipline_controller and not serving:
+            # worker 0's decision journal is the trial's discipline
+            # evidence (every worker runs the identical seeded program,
+            # so one trace represents them all; per-worker divergence
+            # is the invariant's job, not the summary's)
+            from ..obsv import schema as _schema
+            from ..obsv.journal import summarize_discipline
+            from ..obsv.report import load_jsonl
+            outcome["discipline"] = summarize_discipline(load_jsonl(
+                lcfg.worker_dir(0) / "train_log.jsonl",
+                _schema.DISCIPLINE))
         outcome["duration_s"] = round(time.monotonic() - t0, 3)
         (lcfg.root / "outcome.json").write_text(
             json.dumps(outcome, indent=2, default=str))
@@ -1286,6 +1341,8 @@ class ChaosCampaign:
             if outcome.get("broker"):
                 rec["broker"] = True
                 rec["autoscale"] = outcome.get("autoscale")
+            if outcome.get("discipline") is not None:
+                rec["discipline"] = outcome["discipline"]
             if check["violations"] and cfg.shrink and reproducer is None:
                 shrunk = self._shrink(t, schedule, check)
                 rec["shrunk"] = shrunk

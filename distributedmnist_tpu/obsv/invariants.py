@@ -75,7 +75,7 @@ from .report import load_jsonl
 INVARIANTS = ("terminal_state", "metrics_log", "determinism",
               "causality", "checkpoint_integrity", "reconfigure",
               "serve_outcomes", "serve_digest", "serve_monotone",
-              "decode_swap", "serve_group", "autoscale")
+              "decode_swap", "serve_group", "autoscale", "discipline")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -539,6 +539,150 @@ def check_autoscale(outcome: dict, journal_records: list[dict]
 
 
 # ---------------------------------------------------------------------------
+# (12) discipline: every adaptive-controller parameter change licensed
+# ---------------------------------------------------------------------------
+
+def check_discipline(steps: list[dict], log_records: list[dict],
+                     worker: int | None = None
+                     ) -> tuple[list[Violation], bool]:
+    """Invariant (12) over one worker's train log. Returns
+    ``(violations, applicable)`` — not applicable (verdict: skipped)
+    when the log carries neither discipline events nor per-step
+    discipline observations (controller never armed).
+
+    The causal-license rule, same discipline as invariants 6/11, with
+    the step series itself as the observation channel: adaptive mode
+    stamps every step record with the ``[k, timeout_ms]`` pair in force
+    (obsv/schema.py STEP optional), so a parameter change is OBSERVED
+    as two adjacent spliced step records disagreeing. Three claims:
+
+    * every ``discipline begin`` carries a license that actually holds
+      — ``value op threshold`` re-checked with the emitter's OWN
+      predicate (train/discipline.py ``threshold_holds``), so a begin
+      whose recorded CDF signal never crossed the recorded mark is a
+      fabricated license;
+    * episodes are single-flight and closed: begin → its ``complete``
+      (agreeing on the new pair) before the next begin, none dangling,
+      and each complete's ``effective_step`` is exactly the step after
+      its begin's ``at_step`` — the epoch boundary;
+    * every OBSERVED pair change in the spliced step series is consumed
+      against a licensed complete naming that exact boundary and pair
+      — a doctored step record (or a deleted begin) fails replay.
+
+    Rollback tolerance: the series is spliced first (the invariant-2
+    view), and a licensed change whose boundary step was superseded by
+    a rewind simply goes unconsumed — licenses are permissions, not
+    obligations."""
+    from ..train.discipline import threshold_holds
+    disc = [r for r in log_records
+            if r.get("event") == schema.DISCIPLINE]
+    observed = [r for r in steps if "discipline" in r]
+    applicable = bool(disc) or bool(observed)
+    out: list[Violation] = []
+    if not applicable:
+        return out, False
+
+    # -- license validity + single-flight pairing ----------------------
+    completes: list[dict] = []
+    open_begin: dict | None = None
+    for r in disc:
+        action = r.get("action")
+        if action == "begin":
+            v, thr, op = r.get("value"), r.get("threshold"), r.get("op")
+            if not (isinstance(v, (int, float))
+                    and isinstance(thr, (int, float))
+                    and op in (">=", "<=")):
+                out.append(Violation(
+                    "discipline",
+                    f"discipline begin ({r.get('decision')}) with a "
+                    f"malformed license: value={v!r} op={op!r} "
+                    f"threshold={thr!r}", worker))
+            elif not threshold_holds(v, op, thr):
+                out.append(Violation(
+                    "discipline",
+                    f"discipline begin ({r.get('decision')}) licensed "
+                    f"by {r.get('trigger')}={v} {op} {thr}, which does "
+                    "not hold — the recorded CDF signal never crossed "
+                    "the recorded percentile mark", worker))
+            if open_begin is not None:
+                out.append(Violation(
+                    "discipline",
+                    "overlapping discipline decisions: a second begin "
+                    f"({r.get('decision')}) before the previous one "
+                    f"({open_begin.get('decision')}) completed — the "
+                    "controller is single-flight by construction",
+                    worker))
+            open_begin = r
+        elif action == "complete":
+            if open_begin is None:
+                out.append(Violation(
+                    "discipline",
+                    f"discipline complete ({r.get('decision')}) with no "
+                    "open begin — an unlicensed change record", worker))
+            else:
+                b = open_begin
+                if (r.get("k") != b.get("new_k")
+                        or r.get("timeout_ms") != b.get("new_timeout_ms")):
+                    out.append(Violation(
+                        "discipline",
+                        f"discipline complete lands on (k={r.get('k')}, "
+                        f"timeout_ms={r.get('timeout_ms')}) but its "
+                        f"begin declared (k={b.get('new_k')}, "
+                        f"timeout_ms={b.get('new_timeout_ms')})", worker))
+                at, eff = b.get("at_step"), r.get("effective_step")
+                if (isinstance(at, int) and isinstance(eff, int)
+                        and eff != at + 1):
+                    out.append(Violation(
+                        "discipline",
+                        f"discipline epoch boundary mismatch: begin at "
+                        f"step {at} but complete claims effective_step "
+                        f"{eff} (must be {at + 1})", worker))
+            completes.append(r)
+            open_begin = None
+    if open_begin is not None:
+        out.append(Violation(
+            "discipline",
+            f"discipline begin ({open_begin.get('decision')}) never "
+            "closed by a complete record", worker))
+
+    # -- observed-change consumption over the spliced series -----------
+    spliced, _ = splice_rollbacks(observed)
+    licenses = list(completes)  # consumed in order
+    prev: dict | None = None
+    for rec in spliced:
+        pair = rec.get("discipline")
+        if prev is not None and pair != prev.get("discipline"):
+            lic = None
+            while licenses:
+                cand = licenses.pop(0)
+                if cand.get("effective_step") == rec.get("step"):
+                    lic = cand
+                    break
+                # boundary superseded by a rewind (or predates this
+                # span): an unconsumed permission, not a violation
+            want = (None if lic is None else
+                    [float(lic.get("k", -1)),
+                     float(lic.get("timeout_ms", -1))])
+            got = ([float(x) for x in pair]
+                   if isinstance(pair, (list, tuple)) else pair)
+            if lic is None:
+                out.append(Violation(
+                    "discipline",
+                    f"step {rec.get('step')} observed a discipline "
+                    f"change {prev.get('discipline')} -> {pair} with no "
+                    "licensing complete at that boundary — an "
+                    "unlicensed parameter change", worker))
+            elif got != want:
+                out.append(Violation(
+                    "discipline",
+                    f"step {rec.get('step')} observed discipline {got} "
+                    f"but the licensing complete declared {want}",
+                    worker))
+        prev = rec
+    return out, True
+
+
+# ---------------------------------------------------------------------------
 # (7-9) serving invariants (the online inference tier under chaos)
 # ---------------------------------------------------------------------------
 
@@ -944,18 +1088,39 @@ def check_run(trial_dir: str | Path, outcome: dict | None = None,
             restarts_by_worker[r["worker"]] = (
                 restarts_by_worker.get(r["worker"], 0) + 1)
 
+    # invariant (3) under the adaptive controller is epoch-spliced:
+    # bitwise WITHIN a discipline epoch, causal ACROSS them. With only
+    # terminal digests as artifacts, the comparable case is identical
+    # epoch histories (the seeded-synthetic contract: same decisions →
+    # same series → bitwise must hold end-to-end); a trial whose
+    # licensed trace diverged from the reference's has no common final
+    # epoch to compare, so its digest check is spliced out — the
+    # discipline invariant still holds every change to account.
+    from ..train.discipline import discipline_trace
+    ref_trace: list = []
+    if reference_dir is not None:
+        ref_trace = discipline_trace(
+            load_jsonl(Path(reference_dir) / "train_log.jsonl"))
+
     det_checked = 0
+    det_spliced = 0
+    disc_applicable = False
     for k, d in sorted(workers.items()):
         if k in serve_workers:
             # serving replicas have no train series or checkpoints —
             # their artifacts are replayed by check_serving above
             continue
+        full_log = load_jsonl(d / "train_log.jsonl")
         # the trainer stamps event:"step"; minimal payloads (chaos
         # shell smoke, the reference's own tools) may write bare
         # {"step": N, ...} records — both are the metrics series
-        steps = [r for r in load_jsonl(d / "train_log.jsonl")
+        steps = [r for r in full_log
                  if isinstance(r.get("step"), int)
                  and r.get("event", schema.STEP) == schema.STEP]
+        disc_violations, disc_app = check_discipline(
+            steps, full_log, worker=k)
+        violations += disc_violations
+        disc_applicable = disc_applicable or disc_app
         if k in grown and not steps:
             # a grown worker that never produced a step before
             # teardown has nothing to splice — its resume evidence is
@@ -977,6 +1142,13 @@ def check_run(trial_dir: str | Path, outcome: dict | None = None,
             expect_first_step=None if k in grown else 1)
         violations += check_checkpoint_dir(d, exempt.get(k, set()), worker=k)
         if reference_dir is not None:
+            if discipline_trace(full_log) != ref_trace:
+                # divergent epoch history: the bitwise claim stops at
+                # the first differing boundary, before the terminal
+                # digest — splice this worker out, causality above
+                # remains the binding check
+                det_spliced += 1
+                continue
             checked, det_violations = determinism_verdict(
                 d, reference_dir, worker=k, reference_digest=ref_digest,
                 reference_opt_digest=ref_opt_digest)
@@ -987,7 +1159,10 @@ def check_run(trial_dir: str | Path, outcome: dict | None = None,
     elif det_checked == 0:
         # every worker was left short of the reference step — nothing
         # was "fully recovered", so the bitwise claim has no subject
+        # (or every worker was epoch-spliced out)
         skipped.add("determinism")
+    if not disc_applicable:
+        skipped.add("discipline")
 
     failed = {v.invariant for v in violations}
     verdicts = {inv: ("fail" if inv in failed
@@ -996,7 +1171,8 @@ def check_run(trial_dir: str | Path, outcome: dict | None = None,
     return {"verdicts": verdicts,
             "violations": [v.to_dict() for v in violations],
             "workers": sorted(workers),
-            "determinism_workers_checked": det_checked}
+            "determinism_workers_checked": det_checked,
+            "determinism_workers_spliced": det_spliced}
 
 
 # ---------------------------------------------------------------------------

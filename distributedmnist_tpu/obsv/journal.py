@@ -261,6 +261,71 @@ def summarize_autoscale(records: list[dict]) -> dict[str, Any]:
     return out
 
 
+def summarize_discipline(records: list[dict]) -> dict[str, Any]:
+    """Aggregate a run's ``event: "discipline"`` records (the straggler
+    discipline controller's decision journal, ``train/discipline.py``)
+    into its adaptation evidence — the same shape
+    :func:`summarize_autoscale` gives the broker:
+
+    * ``changes`` / ``completed`` — begin records and how many closed,
+    * ``by_trigger`` / ``by_direction`` — which CDF signal licensed
+      each change and which way the discipline moved (tighten/relax
+      quorum, retarget/restore timeout),
+    * ``trace`` — the per-window discipline trajectory
+      ``[(effective_step, k, timeout_ms), ...]`` from the completes:
+      the parameter-vs-step curve a bench report plots,
+    * ``reaction_s`` — decide→staged latency percentiles,
+    * ``flaps`` — consecutive opposite-direction changes closer (in
+      STEPS — the controller's clock) than twice the recorded
+      cooldown: the oscillation the dead band exists to prevent,
+      surfaced so campaigns gate on it staying zero.
+    """
+    begins = [r for r in records if r.get("event") == schema.DISCIPLINE
+              and r.get("action") == "begin"]
+    completes = [r for r in records if r.get("event") == schema.DISCIPLINE
+                 and r.get("action") == "complete"]
+    by_trigger: dict[str, int] = {}
+    by_direction: dict[str, int] = {}
+    for r in begins:
+        t = r.get("trigger", "?")
+        by_trigger[t] = by_trigger.get(t, 0) + 1
+        d = r.get("decision", "?")
+        by_direction[d] = by_direction.get(d, 0) + 1
+    # tighten_* vs relax_*/restore_* are the two directions; a flap is
+    # a reversal inside 2× the step cooldown
+    def _dir(decision: str | None) -> str:
+        return "tighten" if (decision or "").startswith("tighten") \
+            else "relax"
+    flaps = 0
+    prev: dict | None = None
+    for r in begins:
+        if prev is not None and _dir(r.get("decision")) != _dir(
+                prev.get("decision")):
+            gap = (r.get("at_step") or 0) - (prev.get("at_step") or 0)
+            lim = 2 * int(r.get("cooldown_steps") or 40)
+            if 0 <= gap < lim:
+                flaps += 1
+        prev = r
+    trace = [(r.get("effective_step"), r.get("k"), r.get("timeout_ms"))
+             for r in completes]
+    out: dict[str, Any] = {"changes": len(begins),
+                           "completed": len(completes),
+                           "by_trigger": by_trigger,
+                           "by_direction": by_direction,
+                           "flaps": flaps,
+                           "trace": trace,
+                           "reaction_s": {}}
+    reactions = sorted(float(r["reaction_s"]) for r in completes
+                       if isinstance(r.get("reaction_s"), (int, float)))
+    if reactions:
+        out["reaction_s"] = {
+            "mean": round(sum(reactions) / len(reactions), 3),
+            "p50": _percentile(reactions, 0.50),
+            "p99": _percentile(reactions, 0.99),
+            "max": reactions[-1]}
+    return out
+
+
 def summarize_chaos(path: str | Path) -> dict[str, Any]:
     """Aggregate a chaos campaign's ``chaos_report.jsonl`` (one
     ``event: "chaos_trial"`` record per trial, written by
@@ -279,6 +344,7 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
     fault_trials: list[dict[str, Any]] = []
     serving_trials: list[dict[str, Any]] = []
     autoscale_trials: list[dict[str, Any]] = []
+    discipline_trials: list[dict[str, Any]] = []
     reconfigures = 0
     swaps_by_tier: dict[str, int] = {}
     quant_fallbacks = 0
@@ -324,6 +390,14 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
                 "by_direction": a.get("by_direction") or {},
                 "flaps": a.get("flaps", 0),
                 "reaction_p99_s": (a.get("reaction_s") or {}).get("p99")})
+        dc = rec.get("discipline")
+        if dc is not None:
+            discipline_trials.append({
+                "trial": rec.get("trial"),
+                "changes": dc.get("changes", 0),
+                "by_direction": dc.get("by_direction") or {},
+                "flaps": dc.get("flaps", 0),
+                "trace": dc.get("trace") or []})
         f = rec.get("faults")
         if f is not None:
             fault_trials.append({"trial": rec.get("trial"),
@@ -439,7 +513,26 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
                     (t["reaction_p99_s"] for t in autoscale_trials
                      if t["reaction_p99_s"] is not None), default=None),
                 "per_trial": autoscale_trials}
-                if autoscale_trials else None)}
+                if autoscale_trials else None),
+            # controller-armed campaigns: the straggler-discipline
+            # evidence per trial and in aggregate — the nightly gate
+            # asserts changes fired with zero flaps and every trial's
+            # discipline invariant green
+            "discipline": ({
+                "trials": len(discipline_trials),
+                "changes": sum(t["changes"] or 0
+                               for t in discipline_trials),
+                "tightens": sum(
+                    n for t in discipline_trials
+                    for d, n in t["by_direction"].items()
+                    if d.startswith("tighten")),
+                "relaxes": sum(
+                    n for t in discipline_trials
+                    for d, n in t["by_direction"].items()
+                    if not d.startswith("tighten")),
+                "flaps": sum(t["flaps"] or 0 for t in discipline_trials),
+                "per_trial": discipline_trials}
+                if discipline_trials else None)}
 
 
 def summarize_journal(path: str | Path) -> dict[str, Any]:

@@ -55,6 +55,7 @@ SPAWN = "spawn"
 CHAOS_TRIAL = "chaos_trial"
 EVAL = "eval"
 AUTOSCALE = "autoscale"
+DISCIPLINE = "discipline"
 
 # Fields any journaled record may carry regardless of kind: the sink
 # stamps ``ts``, emitters stamp ``time``, the supervisor stamps ``seed``
@@ -239,12 +240,17 @@ _declare(EventSchema(
     },
 ))
 
-# Trainer metrics series (train/loop.py train_log.jsonl).
+# Trainer metrics series (train/loop.py train_log.jsonl).  The
+# optional ``discipline`` field is the [k, timeout_ms] pair in force
+# when the step ran — written only when the adaptive controller is
+# armed, and the per-step observation the ``discipline`` replay
+# invariant matches licensed changes against.
 _declare(EventSchema(
     STEP,
     required=("step", "time", "loss", "train_acc", "lr",
               "updates_applied", "num_contributors", "examples_per_sec",
               "flags"),
+    optional=("discipline",),
 ))
 
 # Checkpoint-save marker.  Deliberately ``at_step``, NOT ``step``: the
@@ -346,7 +352,7 @@ _declare(EventSchema(
               "step", "target", "duration_s", "verdicts", "violations"),
     optional=("mttr", "boot_s", "stall_timeout_s", "faults",
               "reconfigures", "final_world", "serving", "serve_swaps",
-              "shrunk", "broker", "autoscale"),
+              "shrunk", "broker", "autoscale", "discipline"),
 ))
 
 # Continuous evaluator (evalsvc/evaluator.py eval_log.jsonl).
@@ -374,6 +380,29 @@ _declare(EventSchema(
                           "train"),
                          ("worker", "grown", "dropped")),
         "error": _act(("decision", "error")),
+    },
+))
+
+# Adaptive straggler-discipline changes (train/discipline.py, written
+# to the trainer's train_log.jsonl) — the causal LICENSE the
+# ``discipline`` replay invariant requires for every runtime change of
+# the aggregation parameters.  ``begin`` names the CDF-percentile
+# crossing that licensed the change (``value op threshold`` must hold,
+# re-checked at replay); ``complete`` closes the episode once the new
+# [k, timeout_ms] vector is staged and names the first step it governs
+# (``effective_step`` — the discipline-epoch boundary the determinism
+# invariant splices at).
+_declare(EventSchema(
+    DISCIPLINE,
+    required=("action",),
+    actions={
+        "begin": _act(("decision", "trigger", "value", "threshold",
+                       "op", "old_k", "new_k", "old_timeout_ms",
+                       "new_timeout_ms", "at_step"),
+                      ("window_steps", "cooldown_steps", "p50_ms",
+                       "p99_ms", "num_replicas")),
+        "complete": _act(("decision", "trigger", "reaction_s", "k",
+                          "timeout_ms", "effective_step")),
     },
 ))
 

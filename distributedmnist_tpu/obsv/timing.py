@@ -74,6 +74,11 @@ class StepTimeCollector:
         # comm time, plus the per-save snapshot stall series.
         self._overlap: dict[str, Any] | None = None
         self._snapshot_stalls: list[float] = []  # ms per save event
+        # rolling-CDF window (set only when the adaptive discipline
+        # controller is armed — same present-iff-on pattern): the
+        # report then carries per-replica p50/p90/p99 over the LAST
+        # window, the exact gauges the controller decides on.
+        self._rolling_window: int | None = None
 
     def add(self, per_replica_times: Any, host_step_seconds: float | None = None,
             prefetch_depth: int | None = None) -> None:
@@ -143,6 +148,44 @@ class StepTimeCollector:
     def snapshot_stall_stats(self) -> CdfStats:
         return compute_stats(np.asarray(self._snapshot_stalls, np.float64))
 
+    def enable_rolling_cdf(self, window_steps: int) -> None:
+        """Arm the rolling-window gauges (the adaptive discipline
+        controller's view of the CDF; train/loop.py sets this iff
+        ``sync.adaptive``)."""
+        if window_steps < 1:
+            raise ValueError(f"window_steps must be >= 1, got {window_steps}")
+        self._rolling_window = int(window_steps)
+
+    def rolling_cdf(self, window_steps: int | None = None
+                    ) -> dict[str, Any] | None:
+        """Per-replica p50/p90/p99 (and the pooled tail ratio) over the
+        last ``window_steps`` rows — None until the window is full, so
+        callers never decide on a half-filled CDF."""
+        w = self._rolling_window if window_steps is None else int(window_steps)
+        if w is None or len(self._raw) < w:
+            return None
+        tail = self.matrix()[-w:]
+        pcts = np.percentile(tail, (50.0, 90.0, 99.0), axis=0)  # [3, n]
+        pooled = np.percentile(tail, (50.0, 90.0, 99.0))
+        p50 = float(pooled[0])
+        # the fastest replica's median = the cohort pace. The pooled
+        # p50 drifts to the midpoint once ~half the replicas straggle;
+        # the controller's tail ratio divides by THIS instead
+        fast_p50 = float(pcts[0].min())
+        return {
+            "window_steps": w,
+            "per_replica": [
+                {"p50": float(pcts[0, i]), "p90": float(pcts[1, i]),
+                 "p99": float(pcts[2, i])}
+                for i in range(tail.shape[1])],
+            "p50_ms": p50,
+            "p90_ms": float(pooled[1]),
+            "p99_ms": float(pooled[2]),
+            "fast_p50_ms": fast_p50,
+            "tail_ratio": (float(pooled[2]) / fast_p50
+                           if fast_p50 > 0 else 0.0),
+        }
+
     def prefetch_depth_stats(self) -> CdfStats:
         """Distribution of the device-prefetch queue depth sampled at
         each step's dequeue: pinned at 0 means the producer (host
@@ -161,6 +204,10 @@ class StepTimeCollector:
         }
         if self._prefetch_depths:
             out["prefetch_queue_depth"] = self.prefetch_depth_stats().to_dict()
+        if self._rolling_window is not None:
+            rolling = self.rolling_cdf()
+            if rolling is not None:
+                out["rolling_cdf"] = rolling
         if self._overlap is not None:
             overlap = dict(self._overlap)
             if self._snapshot_stalls:

@@ -48,6 +48,23 @@ logger = get_logger("parallel")
 # plain callable type here to avoid a parallel<->train import cycle).
 Schedule = Callable[[jax.Array], jax.Array]
 
+# Runtime discipline vector layout: the aggregation-discipline
+# parameters ride into the compiled step as ONE replicated [3] float32
+# input (spec P()), so the adaptive controller (train/discipline.py)
+# changes discipline by swapping a 12-byte buffer — never by
+# recompiling. Indexed symbolically everywhere; order is part of the
+# AOT signature, so reordering would invalidate precompiled caches.
+DISC_K = 0            # quorum size (integer-valued float; rounded in use)
+DISC_TIMEOUT_MS = 1   # timeout-mode deadline
+DISC_INTERVAL_MS = 2  # interval-mode window / staleness bound
+
+
+def make_discipline_vector(k: float, timeout_ms: float,
+                           interval_ms: float) -> jax.Array:
+    """Pack runtime discipline params as the traced [3] step input."""
+    return jnp.asarray([float(k), float(timeout_ms), float(interval_ms)],
+                       jnp.float32)
+
 
 class TrainState(struct.PyTreeNode):
     """Replicated training state (a pure pytree).
@@ -797,20 +814,30 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
                      schedule: Schedule) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Compile the per-step SPMD training function.
 
-    Returns ``step_fn(state, batch, measured_ms=None) -> (state, metrics)``
-    where ``batch = {"image": [B, ...], "label": [B]}`` is globally
-    batched and sharded over the replica axis, and state/metrics are
-    replicated. ``measured_ms`` is an optional per-replica [n] vector of
-    real measured step times (ms), sharded over the replica axis: each
-    host feeds the entries for its own replicas (Topology.
-    device_put_measured), so quorum/timeout/interval policies select on
-    genuine per-replica speed — ≙ the reference's measured per-worker
-    CDF semantics (src/timeout_manager.py:48-61) without the RPC mesh.
-    Defaults to zeros (pure synthetic-profile timing).
+    Returns ``step_fn(state, batch, measured_ms=None, discipline=None)
+    -> (state, metrics)`` where ``batch = {"image": [B, ...], "label":
+    [B]}`` is globally batched and sharded over the replica axis, and
+    state/metrics are replicated. ``measured_ms`` is an optional
+    per-replica [n] vector of real measured step times (ms), sharded
+    over the replica axis: each host feeds the entries for its own
+    replicas (Topology.device_put_measured), so quorum/timeout/interval
+    policies select on genuine per-replica speed — ≙ the reference's
+    measured per-worker CDF semantics (src/timeout_manager.py:48-61)
+    without the RPC mesh. Defaults to zeros (pure synthetic-profile
+    timing).
+
+    ``discipline`` is an optional replicated [3] float32 vector
+    ``(k, timeout_ms, interval_ms)`` (make_discipline_vector) carrying
+    the aggregation-discipline parameters as *traced* inputs: the
+    adaptive straggler controller (train/discipline.py) swaps this
+    scalar buffer at runtime and the same compiled executable keeps
+    running — a discipline change costs a device_put, not a recompile.
+    Defaults to the static values from ``cfg.sync``.
     """
     axis = topo.replica_axis
     n = topo.num_replicas
     sync = cfg.sync
+    sync.validate(num_replicas=n)
     mode = sync.mode
     if mode not in ("sync", "quorum", "timeout", "interval", "cdf"):
         raise ValueError(f"unknown sync mode {mode!r}")
@@ -1018,11 +1045,16 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
                      make_sp_loss(pp_apply, has_aux)
                      if (pp_apply is not None and n_seq > 1) else None)
 
-    def shard_fn(state: TrainState, batch: dict,
-                 measured_ms: jax.Array) -> tuple[TrainState, dict]:
+    def shard_fn(state: TrainState, batch: dict, measured_ms: jax.Array,
+                 discipline: jax.Array) -> tuple[TrainState, dict]:
         me = lax.axis_index(axis)
         step = state.step
         my_measured_ms = measured_ms[0]  # this replica's [1]-shard
+        # runtime discipline params (replicated [3]): traced, so the
+        # adaptive controller swaps them without a recompile
+        disc_k = discipline[DISC_K]
+        disc_timeout_ms = discipline[DISC_TIMEOUT_MS]
+        disc_interval_ms = discipline[DISC_INTERVAL_MS]
 
         # --- local forward+backward (one pass: the reference's second
         # forward per step, src/distributed_train.py:332-335, is a
@@ -1128,17 +1160,18 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         if mode in ("sync", "cdf"):
             flag = jnp.ones((), jnp.float32)
         elif mode == "quorum":
-            flag = policies.quorum_flag(t_ms, k, axis)
+            flag = policies.quorum_flag(t_ms, disc_k, axis)
         elif mode == "timeout":
-            flag = policies.timeout_flag(t_ms, sync.timeout_ms)
+            flag = policies.timeout_flag(t_ms, disc_timeout_ms)
         else:  # interval: stale if slower than a whole window
-            flag = policies.timeout_flag(t_ms, sync.interval_ms)
+            flag = policies.timeout_flag(t_ms, disc_interval_ms)
 
         # --- apply discipline ----------------------------------------
         t_next = state.updates_applied.astype(jnp.float32) + 1.0
         if mode == "interval":
             mean_grads, num_contrib = masked_mean_psum(grads, flag, axis)
-            new_state, applied = _interval_apply(state, mean_grads, t_ms)
+            new_state, applied = _interval_apply(state, mean_grads, t_ms,
+                                                 disc_interval_ms)
         elif z_plan is not None:
             # ZeRO-1: no full mean gradient is ever built — the
             # reduce-scatter inside _zero1_update hands each replica
@@ -1201,7 +1234,8 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         return new_state, metrics
 
     def _interval_apply(state: TrainState, mean_grads: Any,
-                        t_ms: jax.Array) -> tuple[TrainState, jax.Array]:
+                        t_ms: jax.Array,
+                        interval_ms: jax.Array) -> tuple[TrainState, jax.Array]:
         """Wall-clock-windowed aggregation (≙ the chief's recurring
         Timer running take_grad(1)-average-of-arrived,
         sync_replicas_optimizer_modified.py:208-215,371-373,392-393).
@@ -1235,7 +1269,7 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         new_rounds = jnp.where(fire, 0.0, rounds)
         # Reschedule relative to *now*, as the reference timer does by
         # re-arming after each run (skipped windows are not replayed).
-        next_apply = jnp.where(fire, wall + sync.interval_ms, state.next_apply_ms)
+        next_apply = jnp.where(fire, wall + interval_ms, state.next_apply_ms)
         applied = fire.astype(jnp.int32)
         return state.replace(
             params=new_params, momentum=new_bufs, window_acc=new_acc,
@@ -1251,11 +1285,12 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
     batch_spec = P(axis, seq_ax) if n_seq > 1 else P(axis)
     sharded = mesh_lib.shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(state_specs, batch_spec, P(axis)),
+        in_specs=(state_specs, batch_spec, P(axis), P()),
         out_specs=(state_specs, metrics_specs))
     jitted = jax.jit(sharded, donate_argnums=0)
 
     zeros_ms: list[jax.Array] = []  # lazily built + cached default
+    disc_default: list[jax.Array] = []  # static-cfg discipline vector
     # AOT fast path (parallel/aot.py): precompile() fills this with the
     # ahead-of-time compiled executable + the argument signature it was
     # lowered for; step_fn then dispatches matching concrete calls
@@ -1268,6 +1303,12 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
             zeros_ms.append(topo.zeros_measured())
         return zeros_ms[0]
 
+    def _default_discipline() -> jax.Array:
+        if not disc_default:
+            disc_default.append(make_discipline_vector(
+                k, sync.timeout_ms, sync.interval_ms))
+        return disc_default[0]
+
     def _args_sig(args):
         leaves, treedef = jax.tree.flatten(args)
         return (treedef,
@@ -1275,9 +1316,12 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
                       for x in leaves))
 
     def step_fn(state: TrainState, batch: dict,
-                measured_ms: jax.Array | None = None):
+                measured_ms: jax.Array | None = None,
+                discipline: jax.Array | None = None):
         if measured_ms is None:
             measured_ms = _default_measured()
+        if discipline is None:
+            discipline = _default_discipline()
         exe = aot_box.get("exe")
         if exe is not None:
             # one flatten covers both guards: tracers ANYWHERE in the
@@ -1287,7 +1331,8 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
             # signature (a test swapping batch shapes) simply compiles
             # through jit as before. Compared leafwise with early exit —
             # no per-step sig allocation on this hot path.
-            leaves, treedef = jax.tree.flatten((state, batch, measured_ms))
+            leaves, treedef = jax.tree.flatten(
+                (state, batch, measured_ms, discipline))
             sig_td, sig_leaves = aot_box["sig"]
             if (treedef == sig_td and len(leaves) == len(sig_leaves)
                     and not any(isinstance(x, jax.core.Tracer)
@@ -1295,11 +1340,12 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
                     and all(getattr(x, "shape", ()) == s
                             and getattr(x, "dtype", None) == d
                             for x, (s, d) in zip(leaves, sig_leaves))):
-                return exe(state, batch, measured_ms)
-        return jitted(state, batch, measured_ms)
+                return exe(state, batch, measured_ms, discipline)
+        return jitted(state, batch, measured_ms, discipline)
 
     def precompile(state: TrainState, batch: dict,
                    measured_ms: jax.Array | None = None,
+                   discipline: jax.Array | None = None,
                    cache_dir=None, cache_key: str | None = None,
                    trust_cross_process: bool = False) -> dict[str, Any]:
         """AOT-compile the step for these exact avals (no execution, no
@@ -1310,16 +1356,19 @@ def build_train_step(model: Model, cfg: ExperimentConfig, topo: Topology,
         from . import aot as aot_lib
         if measured_ms is None:
             measured_ms = _default_measured()
+        if discipline is None:
+            discipline = _default_discipline()
         compiled, info = aot_lib.aot_compile(
-            jitted, (state, batch, measured_ms),
+            jitted, (state, batch, measured_ms, discipline),
             cache_dir=cache_dir, key=cache_key,
             trust_cross_process=trust_cross_process)
         aot_box["exe"] = compiled
-        aot_box["sig"] = _args_sig((state, batch, measured_ms))
+        aot_box["sig"] = _args_sig((state, batch, measured_ms, discipline))
         return info
 
     step_fn.precompile = precompile
     step_fn.jitted = jitted
+    step_fn.default_discipline = _default_discipline
     return step_fn
 
 

@@ -75,17 +75,23 @@ def rank_by_time(time_ms: jax.Array, axis_name: str) -> jax.Array:
     return jnp.sum(earlier.astype(jnp.int32))
 
 
-def quorum_flag(time_ms: jax.Array, k: int, axis_name: str) -> jax.Array:
+def quorum_flag(time_ms: jax.Array, k: int | jax.Array, axis_name: str) -> jax.Array:
     """k-of-n backup-worker mask: 1 for the k fastest replicas
     (≙ replicas_to_aggregate=k; the n−k slowest are the "backups" whose
-    work is discarded, arXiv:1604.00981 semantics)."""
-    return (rank_by_time(time_ms, axis_name) < k).astype(jnp.float32)
+    work is discarded, arXiv:1604.00981 semantics).
+
+    ``k`` may be a traced scalar (the adaptive discipline controller
+    swaps it at runtime without recompiling); integer-valued floats are
+    rounded, never truncated."""
+    k_i = jnp.round(jnp.asarray(k, jnp.float32)).astype(jnp.int32)
+    return (rank_by_time(time_ms, axis_name) < k_i).astype(jnp.float32)
 
 
-def timeout_flag(time_ms: jax.Array, timeout_ms: float) -> jax.Array:
+def timeout_flag(time_ms: jax.Array, timeout_ms: float | jax.Array) -> jax.Array:
     """Deadline straggler drop: replicas slower than the deadline are
-    masked out instead of killed (≙ src/timeout_manager.py:38-46)."""
-    return (time_ms <= timeout_ms).astype(jnp.float32)
+    masked out instead of killed (≙ src/timeout_manager.py:38-46).
+    ``timeout_ms`` may be a traced scalar (runtime-adaptive deadline)."""
+    return (time_ms <= jnp.asarray(timeout_ms, jnp.float32)).astype(jnp.float32)
 
 
 def resolve_aggregate_k(cfg: SyncConfig, num_replicas: int) -> int:

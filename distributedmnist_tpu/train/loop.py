@@ -174,6 +174,20 @@ class Trainer:
                                 else None)
 
         self.collector = StepTimeCollector(num_replicas=n)
+        # Adaptive straggler discipline (sync.adaptive): the controller
+        # watches the collector's rolling CDF and swaps the traced
+        # [k, timeout_ms, interval_ms] step input at flush cadence
+        # (train/discipline.py). Every process runs the SAME controller
+        # on the SAME replicated [n] timing metrics, so all processes
+        # swap identically; only the writer journals the begin/complete
+        # pair (_sink_write gates).
+        self._discipline = None
+        if cfg.sync.adaptive:
+            from ..parallel.api import make_discipline_vector
+            from .discipline import DisciplineController
+            self._discipline = DisciplineController(
+                cfg.sync, n, self._sink_write, make_discipline_vector)
+            self.collector.enable_rolling_cdf(cfg.sync.adaptive_window_steps)
         # comm-overlap gauges (parallel.comm_buckets > 1): the bucket
         # structure is known at build; the per-bucket comm calibration
         # joins in precompile() (obsv/timing.py set_overlap_info)
@@ -764,6 +778,11 @@ class Trainer:
                     # per-replica contribution mask — which replicas'
                     # gradients entered this step's masked mean
                     "flags": np.asarray(m["flags"]).astype(int).tolist(),
+                    # adaptive mode: the [k, timeout_ms] in force for
+                    # this step — params only change at flush end, so
+                    # every pending step ran under the current pair
+                    **({"discipline": self._discipline.params_list()}
+                       if self._discipline is not None else {}),
                 }
                 self._sink_write(record)
                 final_metrics = record
@@ -788,6 +807,20 @@ class Trainer:
                                   (now - last_log_t) / max(upto - last_log_step, 1)))
             pending.clear()
             last_log_t, last_log_step = now, upto
+            # adaptive discipline: evaluate AFTER the window's records
+            # are written — a change licensed here governs from the
+            # NEXT step (effective_step = upto + 1), so the records
+            # above correctly carry the pre-change pair
+            if self._discipline is not None:
+                rolling = self.collector.rolling_cdf()
+                if rolling is not None:
+                    from .discipline import WindowStats
+                    self._discipline.maybe_adapt(upto, WindowStats(
+                        p50_ms=rolling["p50_ms"],
+                        p90_ms=rolling["p90_ms"],
+                        p99_ms=rolling["p99_ms"],
+                        n_samples=rolling["window_steps"],
+                        fast_p50_ms=rolling["fast_p50_ms"]))
 
         # Recurring per-window trace dumps (cfg.trace_every_steps): a
         # one-step trace each cadence window, each under its own
@@ -857,8 +890,10 @@ class Trainer:
                 else:
                     gbatch = self.topo.device_put_batch(
                         next(feed), seq_sharded=self.seq_sharded)
-                self.state, metrics = self.step_fn(self.state, gbatch,
-                                                   measured_vector())
+                self.state, metrics = self.step_fn(
+                    self.state, gbatch, measured_vector(),
+                    None if self._discipline is None
+                    else self._discipline.vector)
                 # host_dt is the per-HOST base time and must be captured
                 # BEFORE the probe's drain poll — otherwise one slow device
                 # would inflate every local replica's base (and the slow
@@ -997,4 +1032,7 @@ class Trainer:
             # cache did — journaled in train_log.jsonl too
             "compile": self._compile_info,
         }
+        if self._discipline is not None:
+            # adaptive-controller roll-up: change count + epoch trace
+            summary["discipline"] = self._discipline.summary()
         return summary

@@ -75,6 +75,41 @@ def test_straggler_profiles_deterministic():
     assert 10 <= n_spikes <= 60
 
 
+def test_spike_profile_tail_magnitude():
+    """Spike steps realize at exactly mean × spike_scale — the 8x
+    stall the adaptive-discipline bench's straggler phases model."""
+    cfg = SyncConfig(straggler_profile="spike", straggler_mean_ms=50.0,
+                     straggler_spike_prob=0.3, straggler_spike_scale=8.0)
+    root = prng.root_key(0)
+    ts = np.array([
+        float(policies.sample_step_time_ms(cfg, root, s, 0, jnp.float32(0)))
+        for s in range(100)])
+    spiked = ts[ts > cfg.straggler_mean_ms * 2]
+    assert len(spiked) > 0
+    np.testing.assert_allclose(
+        spiked, cfg.straggler_mean_ms * cfg.straggler_spike_scale)
+    np.testing.assert_allclose(ts[ts <= cfg.straggler_mean_ms * 2],
+                               cfg.straggler_mean_ms)
+
+
+def test_traced_quorum_k_swaps_without_recompile(topo8):
+    """The adaptive controller's contract at the policy layer: ``k`` is
+    a traced operand, so retightening the quorum is a buffer swap into
+    the SAME compiled executable — jit cache stays at one entry."""
+    def fn(t, k):
+        return policies.quorum_flag(t[0], k[0], "replica")[None]
+
+    jitted = jax.jit(jax.shard_map(
+        fn, mesh=topo8.mesh, in_specs=(P("replica"), P()),
+        out_specs=P("replica")))
+    times = jnp.asarray([5.0, 1.0, 9.0, 3.0, 7.0, 2.0, 8.0, 4.0],
+                        jnp.float32)
+    for k, want in ((3.0, 3), (5.0, 5), (8.0, 8)):
+        flags = np.asarray(jitted(times, jnp.asarray([k], jnp.float32)))
+        assert flags.sum() == want, k
+    assert jitted._cache_size() == 1
+
+
 def test_lognormal_profile_statistics():
     cfg = SyncConfig(straggler_profile="lognormal", straggler_mean_ms=50.0,
                      straggler_sigma=0.5)
