@@ -1413,6 +1413,158 @@ def bench_serving_latency() -> dict:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_degraded_network() -> dict:
+    """Serving under transport faults (ISSUE 19): one real replica
+    behind the netchaos proxy, gated on **exactly-once outcomes** —
+    every request reaches one terminal, duplicates are answered from
+    the dedup cache (never re-executed), and the tail stays bounded.
+
+    Two arms, a FRESH replica each (loadgen request ids restart at 0
+    per sweep — reusing a replica would let arm 1's dedup cache answer
+    arm 2's requests and fake the clean baseline):
+
+      * **clean** — direct connection: the p50/p99 baseline.
+      * **degraded** — the same sweep through a ChaosProxy scripted
+        with added latency+jitter and a one-shot connection reset that
+        cuts the first response mid-wire.  The reset lands AFTER the
+        replica computed and cached the outcome (the protocol caches
+        before sending), so the client's retry must produce a dedup
+        hit, not a second execution.
+
+    Gates: zero drops and zero errors in both arms; the degraded sweep
+    retried >= 1 request and the replica served >= 1 dedup hit; no
+    request id has more than one ``respond`` execution record in the
+    replica's journal (unlicensed duplicate = fail); degraded p99 <=
+    max(5x, +500 ms) of clean p99 (retry backoff may cost a round
+    trip, never a stall)."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    from distributedmnist_tpu.core.config import ExperimentConfig, ServeConfig
+    from distributedmnist_tpu.launch.netchaos import ChaosProxy
+    from distributedmnist_tpu.servesvc.client import ServeClient
+    from distributedmnist_tpu.servesvc.loadgen import make_input_fn, run_load
+    from distributedmnist_tpu.servesvc.server import ServingReplica
+    from distributedmnist_tpu.train.loop import Trainer
+
+    workdir = Path(tempfile.mkdtemp(prefix="dmt_netchaos_bench_"))
+    staging = workdir / "staging"
+    publish = workdir / "publish"
+    publish.mkdir()
+    concurrency, n_requests = 4, 150
+
+    cfg = ExperimentConfig().override({
+        "data.dataset": "synthetic", "data.batch_size": 32,
+        "data.synthetic_train_size": 256,
+        "data.synthetic_test_size": 64,
+        "model.compute_dtype": "float32", "train.max_steps": 20,
+        "train.train_dir": str(staging), "train.log_every_steps": 20,
+        "train.save_interval_steps": 10,
+        "train.async_checkpoint": False,
+        "train.save_results_period": 0})
+    Trainer(cfg).run()
+    name = sorted(staging.glob("ckpt-*.msgpack"))[-1].name
+    for suffix in ("", ".sha256"):
+        shutil.copy2(staging / (name + suffix), publish / (name + suffix))
+    (publish / "checkpoint.json").write_text(json.dumps(
+        {"latest_step": int(name[5:13]), "latest_path": name,
+         "written_at": time.time()}))
+
+    def run_arm(tag: str, proxy_scripts: list[dict] | None):
+        """Boot a fresh replica, warm it DIRECT (string request ids —
+        never colliding with the sweep's integer ids), then sweep
+        through the proxy (or direct for the clean arm)."""
+        replica = ServingReplica(
+            publish, serve_dir=workdir / f"replica_{tag}",
+            scfg=ServeConfig(poll_secs=0.1), cfg=cfg)
+        proxy = None
+        try:
+            replica.start()
+            direct = ("127.0.0.1", replica.bound_port)
+            make_input = make_input_fn(
+                list(replica.model.input_shape),
+                str(np.dtype(replica.model.input_dtype)))
+            warm = ServeClient([direct], deadline_s=5.0)
+            for i in range(2 * concurrency):
+                warm.request(make_input(i), request_id=f"warm-{tag}-{i}")
+            ep = direct
+            if proxy_scripts is not None:
+                proxy = ChaosProxy(direct, proxy_scripts, worker=1,
+                                   seed=0)
+                ep = ("127.0.0.1", proxy.start())
+            client = ServeClient([ep], deadline_s=5.0)
+            sweep = run_load(
+                client, n_requests, concurrency, make_input,
+                journal_path=workdir / f"loadgen_{tag}.jsonl")
+            sweep["dedup_hits"] = replica.dedup_hits
+            # unlicensed duplicate = one id EXECUTED twice; a journal
+            # with two respond records for one id means the dedup
+            # cache failed and the model ran the request again
+            per_id: dict = {}
+            log = workdir / f"replica_{tag}" / "serve_log.jsonl"
+            for line in log.read_text().splitlines():
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("action") == "respond":
+                    rid = rec.get("id")
+                    per_id[rid] = per_id.get(rid, 0) + 1
+            sweep["double_executions"] = sum(
+                n - 1 for n in per_id.values() if n > 1)
+            return sweep
+        finally:
+            if proxy is not None:
+                proxy.stop()
+            try:
+                replica.stop()
+            except Exception:
+                pass
+
+    try:
+        clean = run_arm("clean", None)
+        degraded = run_arm("degraded", [
+            {"kind": "latency", "delay_ms": 8.0, "jitter_ms": 4.0},
+            # any classifier response is >100 bytes: the one-shot cut
+            # always lands mid-response, after the outcome was cached
+            {"kind": "reset", "after_bytes": 100}])
+
+        p99_clean = clean["latency_ms"]["p99"]
+        p99_deg = degraded["latency_ms"]["p99"]
+        p99_bound = max(5.0 * p99_clean, p99_clean + 500.0)
+        no_drop = (clean["dropped"] == 0 and clean["errors"] == 0
+                   and degraded["dropped"] == 0
+                   and degraded["errors"] == 0)
+        dedup_ok = (degraded["retried"] >= 1
+                    and degraded["dedup_hits"] >= 1)
+        no_dupes = (clean["double_executions"] == 0
+                    and degraded["double_executions"] == 0)
+        p99_ok = p99_deg <= p99_bound
+        passes = bool(no_drop and dedup_ok and no_dupes and p99_ok)
+        return {
+            "metric": "degraded_network",
+            "value": p99_deg, "unit": "ms p99 behind chaos proxy",
+            "passes_gate": passes,
+            "detail": {
+                "gate": ("zero dropped/errored requests in both arms "
+                         "AND >=1 retry absorbed as a dedup hit AND "
+                         "zero double executions AND p99_degraded <= "
+                         "max(5x, +500ms) of clean p99"),
+                "offered_load": {"concurrency": concurrency,
+                                 "requests_per_sweep": n_requests},
+                "clean": clean, "degraded": degraded,
+                "p99_clean_ms": p99_clean, "p99_degraded_ms": p99_deg,
+                "p99_bound_ms": round(p99_bound, 3),
+                "no_drop_ok": bool(no_drop),
+                "dedup_absorbed_retry_ok": bool(dedup_ok),
+                "no_double_execution_ok": bool(no_dupes),
+                "p99_gate_ok": bool(p99_ok),
+                **_env_stamp()}}
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_quantized_serving() -> dict:
     """Quantized serving path (ROADMAP item 5): the int8 sidecar tier
     vs the fp32 path on real ServingReplicas under the closed-loop
@@ -2763,7 +2915,8 @@ def main() -> None:
                  bench_input_pipeline_overlap, bench_weight_update_sharding,
                  bench_zero1_overlap, bench_save_stall,
                  bench_weak_scaling, bench_restart_latency,
-                 bench_serving_latency, bench_quantized_serving,
+                 bench_serving_latency, bench_degraded_network,
+                 bench_quantized_serving,
                  bench_decode_throughput, bench_tp_serving,
                  bench_autoscale_response, bench_straggler_adaptation):
         if not want(case):

@@ -646,6 +646,17 @@ class ServeConfig:
     * ``tp_group_max_restarts`` / ``tp_group_poll_secs`` — group
       supervisor knobs: bounded whole-group restarts after a rank
       death, and the child-liveness poll cadence.
+    * ``conn_read_timeout_s`` / ``conn_write_timeout_s`` — per-
+      connection protocol deadlines: the TOTAL time a peer may take to
+      deliver one request line (a slowloris or half-open peer costs
+      one bounded stall, journaled as ``conn_abort``, never a wedged
+      handler), and the ceiling on any single response write (a peer
+      that stopped reading never wedges the batcher).
+    * ``dedup_cache_size`` — bound of the per-replica idempotency
+      cache (request id → final ok outcome). A retried request whose
+      execution already completed here answers from the cache instead
+      of double-executing — the exactly-once half of the network fault
+      contract. 0 disables.
     """
 
     host: str = "127.0.0.1"
@@ -660,6 +671,9 @@ class ServeConfig:
     tp_ranks: int = 1              # >1 = tensor-parallel serving group
     tp_group_max_restarts: int = 3
     tp_group_poll_secs: float = 0.25
+    conn_read_timeout_s: float = 5.0
+    conn_write_timeout_s: float = 5.0
+    dedup_cache_size: int = 256
 
 
 # The serving-tier grammar: what ``serve.precision_tier`` accepts, and

@@ -55,7 +55,9 @@ from .supervisor import ClusterSupervisor, SupervisorConfig
 
 logger = get_logger("chaos")
 
-FAULT_KINDS = ("kill", "hang", "stall", "corrupt", "delay", "resize")
+FAULT_KINDS = ("kill", "hang", "stall", "corrupt", "delay", "resize",
+               "net_latency", "net_bandwidth", "net_reset",
+               "net_blackhole", "net_partition")
 
 # The cheap non-jax payload (the supervisor tests' resuming shell loop):
 # ~20 steps/s, a file "checkpoint" every 5 steps so restarts observably
@@ -140,7 +142,12 @@ class ChaosFault:
     or injected delay (kind=delay); ``verb`` names the delayed command
     class (kind=delay only, worker ignored); ``world`` the target
     world size (kind=resize only — cluster-level, worker ignored: the
-    supervisor shrinks/grows the whole roster at the trigger step)."""
+    supervisor shrinks/grows the whole roster at the trigger step);
+    ``net`` carries a network fault's script parameters as sorted
+    key/value pairs (kind=net_* only — a tuple, not a dict, so the
+    frozen dataclass stays hashable; ``worker`` is the PROXIED
+    replica and ``step`` is unused: transport faults trigger on
+    traffic/wall-time, not train steps)."""
 
     kind: str
     worker: int = 0
@@ -148,6 +155,7 @@ class ChaosFault:
     ms: float = 0.0
     verb: str = ""
     world: int = 0
+    net: tuple[tuple[str, float], ...] = ()
 
     def to_dict(self) -> dict[str, Any]:
         d: dict[str, Any] = {"kind": self.kind}
@@ -155,6 +163,8 @@ class ChaosFault:
             d.update(verb=self.verb, ms=self.ms)
         elif self.kind == "resize":
             d.update(step=self.step, world=self.world)
+        elif self.kind.startswith("net_"):
+            d.update(worker=self.worker, **dict(self.net))
         else:
             d.update(worker=self.worker, step=self.step)
             if self.kind == "stall":
@@ -177,6 +187,7 @@ class ChaosSchedule:
         corrupt: dict[int, int] = {}
         delay: dict[str, float] = {}
         resize: tuple[int, int] | None = None
+        net: dict[int, list[dict]] = {}
         for f in self.faults:
             if f.kind == "kill":
                 kill[f.worker] = f.step
@@ -190,6 +201,11 @@ class ChaosSchedule:
                 delay[f.verb] = f.ms
             elif f.kind == "resize":
                 resize = (f.step, f.world)
+            elif f.kind.startswith("net_"):
+                # one proxy script per proxied replica; the script
+                # grammar is launch/netchaos.py's (kind sans prefix)
+                net.setdefault(f.worker, []).append(
+                    {"kind": f.kind[len("net_"):], **dict(f.net)})
             else:
                 raise ClusterError(f"unknown chaos fault kind {f.kind!r}")
         return FaultPlan(kill_worker_at_step=kill,
@@ -197,7 +213,8 @@ class ChaosSchedule:
                          stall_worker_for_ms_at_step=stall,
                          corrupt_latest_checkpoint_at_step=corrupt,
                          delay_ms=delay,
-                         resize_world_at_step=resize)
+                         resize_world_at_step=resize,
+                         net_faults=net)
 
     def to_json_dict(self) -> dict[str, Any]:
         return {"seed": self.seed, "trial": self.trial,
@@ -209,6 +226,9 @@ class ChaosSchedule:
         return " + ".join(
             (f"{f.kind}(verb={f.verb}, {f.ms:.0f}ms)" if f.kind == "delay"
              else f"{f.kind}(→{f.world}w@{f.step})" if f.kind == "resize"
+             else f"{f.kind}(w{f.worker}: "
+                  + ", ".join(f"{k}={v:g}" for k, v in f.net) + ")"
+             if f.kind.startswith("net_")
              else f"{f.kind}(w{f.worker}@{f.step}"
                   + (f", {f.ms:.0f}ms)" if f.kind == "stall" else ")"))
             for f in self.faults)
@@ -351,6 +371,76 @@ def generate_serving_schedule(seed: int, trial: int,
     return ChaosSchedule(seed=seed, trial=trial, faults=tuple(faults))
 
 
+def generate_network_schedule(seed: int, trial: int,
+                              serve_workers: list[int],
+                              max_faults: int = 3, min_faults: int = 2,
+                              reset_after_bytes: tuple[int, int]
+                              = (450, 800),
+                              partition_start_s: tuple[float, float]
+                              = (1.0, 4.0),
+                              partition_duration_s: tuple[float, float]
+                              = (0.75, 2.0)) -> ChaosSchedule:
+    """Network-mode schedules (deterministic in (seed, trial)); its own
+    generator — and its own rng stream (K=3_000_003, disjoint from the
+    training and serving arms') — because the fault GRAMMAR differs:
+
+    * ALWAYS one mid-stream ``net_reset`` against a serving replica:
+      ``after_bytes`` is drawn ABOVE any single meta/classifier
+      response (≲400 bytes) and INSIDE a decode token stream's
+      cumulative size (~70 bytes/token line), so on a decode replica
+      the cut lands after tokens flowed and before the terminal —
+      the exactly-once retry path the proxy exists to exercise.
+    * ALWAYS one timed ``net_partition`` window, anchored at the
+      proxied replica's first live connection so it opens under load.
+    * Extra latency/bandwidth/blackhole scripts up to ``max_faults``
+      intensity units, at most one of each kind per worker (a proxy
+      script list holds one script per kind).
+
+    All triggers are traffic- or wall-clock-based — network faults
+    have no train-step axis."""
+    import random
+    rng = random.Random(seed * 3_000_003 + trial)
+    faults: list[ChaosFault] = [
+        ChaosFault(kind="net_reset",
+                   worker=rng.choice(list(serve_workers)),
+                   net=(("after_bytes",
+                         rng.randint(*reset_after_bytes)),)),
+        ChaosFault(kind="net_partition",
+                   worker=rng.choice(list(serve_workers)),
+                   net=(("duration_s",
+                         round(rng.uniform(*partition_duration_s), 3)),
+                        ("start_s",
+                         round(rng.uniform(*partition_start_s), 3)))),
+    ]
+    used = {(f.kind, f.worker) for f in faults}
+    n = rng.randint(min_faults, max(min_faults, max_faults))
+    combos = [(kind, w)
+              for kind in ("net_latency", "net_bandwidth",
+                           "net_blackhole")
+              for w in serve_workers]
+    rng.shuffle(combos)
+    units = 2  # the mandatory reset + partition
+    for kind, w in combos:
+        if units >= n:
+            break
+        if (kind, w) in used:
+            continue
+        used.add((kind, w))
+        if kind == "net_latency":
+            net = (("delay_ms", round(rng.uniform(10.0, 60.0), 1)),
+                   ("jitter_ms", round(rng.uniform(0.0, 30.0), 1)))
+        elif kind == "net_bandwidth":
+            # floor well above a response size per second: the cap
+            # slows the wire without starving the request deadline
+            net = (("bytes_per_s", rng.randint(8_192, 65_536)),)
+        else:
+            net = (("conn", rng.randint(0, 4)),
+                   ("hold_s", round(rng.uniform(1.0, 2.5), 3)))
+        faults.append(ChaosFault(kind=kind, worker=w, net=net))
+        units += 1
+    return ChaosSchedule(seed=seed, trial=trial, faults=tuple(faults))
+
+
 def count_fired_faults(trial_dir: Path,
                        schedule: ChaosSchedule) -> dict[str, Any]:
     """Scheduled-vs-actually-fired accounting for one trial, from the
@@ -384,7 +474,11 @@ def count_fired_faults(trial_dir: Path,
         elif f.kind == "resize":
             fired = resize_fired
         else:
-            fired = (fault_actions[f.kind], f.worker) in fired_kw
+            # net_* faults journal under their own kind name (the
+            # proxy's action IS the schedule kind), so the identity
+            # fallback covers them
+            fired = (fault_actions.get(f.kind, f.kind),
+                     f.worker) in fired_kw
         if fired:
             out["fired"] += 1
         else:
@@ -459,6 +553,16 @@ class ChaosConfig:
     # supervisor whose die-as-a-unit restart the serve_group invariant
     # replays — a half-dead TP group must never serve
     serve_tp_ranks: int = 1
+    # network=true swaps the serving arm's process-fault grammar for
+    # the TRANSPORT one (generate_network_schedule): every trial
+    # interposes seeded chaos proxies (launch/netchaos.py) between the
+    # load generator and the net-faulted replicas — always a
+    # mid-stream reset plus a partition window under live load — and
+    # the exactly-once net_faults invariant (13) replays alongside
+    # 7-10. Requires payload=serving AND serve_decode=true: the
+    # mandatory reset must cut a token STREAM mid-generation, and
+    # only the decode wire protocol streams.
+    network: bool = False
     # -- resource broker (serving mode only) ------------------------------
     # broker=true arms demand-driven autoscaling (launch/broker.py)
     # over the trial's roster: DONOR train workers join it
@@ -560,6 +664,23 @@ class ChaosConfig:
                 "serve_precision_tiers: the decode service serves "
                 "full precision only (quant sidecars hold weights for "
                 "the one-shot predict export)")
+        if self.network:
+            if self.payload != "serving":
+                raise ClusterError(
+                    "network=true requires payload=serving: the chaos "
+                    "proxies interpose on the serving wire protocol")
+            if not self.serve_decode:
+                raise ClusterError(
+                    "network=true requires serve_decode=true: the "
+                    "mandatory mid-stream reset must cut a decode "
+                    "token stream, and only the decode protocol "
+                    "streams multi-line responses")
+            if self.broker:
+                raise ClusterError(
+                    "network=true is incompatible with broker=true: "
+                    "the broker's traded roster would outgrow the "
+                    "boot-time proxy set, leaving new replicas "
+                    "unproxied mid-trial")
         if self.broker:
             # the broker recognizes serving slots by command EQUALITY
             # with one uniform serving payload — a mixed-tier roster
@@ -924,6 +1045,7 @@ class ChaosCampaign:
         loadgen_thread: Any = None
         load_stop = None
         load_result: dict[str, Any] = {}
+        proxies: dict[int, Any] = {}
         try:
             # inside the try: a spawn that fails halfway (fork pressure
             # mid-campaign) must still hit the kill_all/close below, or
@@ -932,9 +1054,20 @@ class ChaosCampaign:
             cluster.run_train()
             if broker is not None:
                 broker.start()  # provision the warm serving spares
+            if plan.net_faults:
+                # one seeded chaos proxy per net-faulted replica,
+                # journaling its net_* firings into the same command
+                # journal the process faults use; the loadgen below
+                # routes those replicas' endpoints through the proxy
+                # ports (upstreams re-resolve from serve.json per
+                # connection, so replica restarts stay reachable)
+                from .netchaos import start_proxies
+                proxies = start_proxies(lcfg.root, plan.net_faults,
+                                        journal=executor.journal,
+                                        seed=seed)
             if serving:
                 loadgen_thread, load_stop = self._start_loadgen(
-                    lcfg, load_result)
+                    lcfg, load_result, proxies=proxies)
             got = sup.supervise_until_step(
                 target, poll_secs=cfg.resolved_poll_secs(),
                 timeout_secs=cfg.trial_timeout_s,
@@ -970,6 +1103,8 @@ class ChaosCampaign:
             if loadgen_thread is not None:  # error path: stop the load
                 load_stop.set()
                 loadgen_thread.join(timeout=30)
+            for p in proxies.values():
+                p.stop()
             cluster.kill_all()
             executor.close()
         if serving:
@@ -994,13 +1129,18 @@ class ChaosCampaign:
             # serve journal (tier-less legacy swaps count as fp32) —
             # the evidence a quantized campaign arm actually served
             # its tier, and that sidecar digest refusals fired
-            from ..obsv.journal import summarize_serving_swaps
+            from ..obsv.journal import (summarize_net_chaos,
+                                        summarize_serving_swaps)
             from ..obsv.report import load_jsonl
             serve_recs: list[dict] = []
             for k in outcome["serve_workers"]:
                 serve_recs += load_jsonl(
                     lcfg.worker_dir(k) / "serve_log.jsonl", "serve")
             outcome["serve_swaps"] = summarize_serving_swaps(serve_recs)
+            # network-fault evidence (None when the trial saw none):
+            # proxy firings by kind, dedup-cache hits, retry
+            # amplification — the chaos report's ``net`` slot
+            outcome["net"] = summarize_net_chaos(lcfg.root)
         if cfg.discipline_controller and not serving:
             # worker 0's decision journal is the trial's discipline
             # evidence (every worker runs the identical seeded program,
@@ -1020,13 +1160,19 @@ class ChaosCampaign:
     # -- serving-mode plumbing ------------------------------------------
 
     def _start_loadgen(self, lcfg: LocalClusterConfig,
-                       load_result: dict[str, Any]):
+                       load_result: dict[str, Any],
+                       proxies: dict[int, Any] | None = None):
         """Launch the closed-loop load generator on a background
         thread: wait for the first replica to become ready (its
         ``serve.json`` + a meta answer), then drive traffic through
         the round-robin failover shim until told to stop. The
         per-request journal lands in ``<trial root>/loadgen.jsonl`` —
-        the artifact the serving invariants replay."""
+        the artifact the serving invariants replay.
+
+        ``proxies``: network-mode chaos proxies keyed by proxied
+        worker — those replicas' discovered endpoints are rewritten to
+        the proxy's listen port, so every request to a net-faulted
+        replica crosses its fault scripts."""
         import threading
 
         from ..servesvc.client import ServeClient, discover_endpoints
@@ -1036,10 +1182,24 @@ class ChaosCampaign:
         root = lcfg.root
         stop = threading.Event()
 
+        def endpoints() -> list[dict]:
+            eps = discover_endpoints(root)
+            if not proxies:
+                return eps
+            out = []
+            for e in eps:
+                p = proxies.get(e.get("worker"))
+                if p is not None and p.bound_port:
+                    e = {**e, "host": p.listen_host,
+                         "port": p.bound_port}
+                out.append(e)
+            return out
+
         def drive() -> None:
-            client = ServeClient(lambda: discover_endpoints(root),
+            client = ServeClient(endpoints,
                                  deadline_s=cfg.request_deadline_s,
-                                 max_attempts=6)
+                                 max_attempts=6,
+                                 seed=cfg.seed)
             meta = None
             while meta is None and not stop.is_set():
                 meta = client.meta(deadline_s=1.0)
@@ -1277,6 +1437,14 @@ class ChaosCampaign:
                 # (the gate: roster changes licensed, dropped==0)
                 schedule = ChaosSchedule(seed=cfg.seed, trial=t,
                                          faults=())
+            elif serving and cfg.network:
+                # transport faults only: the proxies carry the whole
+                # chaos load, so the protocol-hardening claims are
+                # tested in isolation from process death
+                schedule = generate_network_schedule(
+                    cfg.seed, t, list(range(1, 1 + cfg.serve_replicas)),
+                    max_faults=cfg.max_faults,
+                    min_faults=max(2, cfg.min_faults))
             elif serving:
                 # faults target the BOOT-TIME replicas only: a donor
                 # trainer's slot may be traded away mid-run, and a
@@ -1336,6 +1504,9 @@ class ChaosCampaign:
                    # model steps served) rides into the campaign report
                    "serving": outcome.get("serving"),
                    "serve_swaps": outcome.get("serve_swaps"),
+                   # network-mode evidence (net_* firings by kind,
+                   # dedup hits, retry percentiles); None off-mode
+                   "net": outcome.get("net"),
                    "verdicts": check["verdicts"],
                    "violations": check["violations"]}
             if outcome.get("broker"):

@@ -1350,6 +1350,17 @@ def main(argv: list[str] | None = None) -> None:
                         "override it)")
     p.add_argument("--no-shrink", action="store_true",
                    help="for chaos: skip minimizing failing schedules")
+    p.add_argument("--serve-decode", action="store_true",
+                   help="for chaos (payload=serving): decode replicas "
+                        "(token streaming) instead of classifiers")
+    p.add_argument("--network", action="store_true",
+                   help="for chaos (payload=serving, requires "
+                        "--serve-decode): transport faults via per-"
+                        "replica chaos proxies (launch/netchaos.py) — "
+                        "mid-stream reset + partition window every "
+                        "trial — instead of process faults; invariant "
+                        "13 (net_faults) replays the exactly-once "
+                        "books")
     p.add_argument("--serve-command", default=None,
                    help="for broker: the serving payload a scaled-up "
                         "replica slot runs — also how the broker "
@@ -1398,6 +1409,12 @@ def main(argv: list[str] | None = None) -> None:
         overrides = {k: v for k, v in overrides.items() if v is not None}
         if args.no_shrink:
             overrides["shrink"] = False
+        # store_true flags: only override when SET, so a chaos-config
+        # file's own values survive the merge
+        if args.serve_decode:
+            overrides["serve_decode"] = True
+        if args.network:
+            overrides["network"] = True
         # merged before construction — __post_init__ validates
         # cross-field constraints, so flags can't land via replace()
         ccfg = (ChaosConfig.from_file(args.chaos_config, overrides=overrides)

@@ -132,6 +132,16 @@ class FaultPlan:
                                          cluster-level, executed by
                                          ``ClusterSupervisor``, not the
                                          backend's poll hook)
+    ``net_faults`` {k: [script, …]}    — transport faults against the
+                                         serving replica on worker
+                                         ``k``, executed by the chaos
+                                         proxy (``launch/netchaos.py``)
+                                         interposed on its endpoint:
+                                         each script is a dict with a
+                                         ``kind`` in {latency,
+                                         bandwidth, reset, blackhole,
+                                         partition} plus kind-specific
+                                         knobs (see ``ChaosProxy``)
 
     Every action fires at most once per worker per run.
     """
@@ -149,6 +159,9 @@ class FaultPlan:
         dataclasses.field(default_factory=dict)
     # (trigger_step, new_world) — None = no resize fault armed
     resize_world_at_step: tuple[int, int] | None = None
+    # {worker: [net fault scripts]} — consumed by netchaos.ChaosProxy
+    net_faults: dict[int, list[dict]] = dataclasses.field(
+        default_factory=dict)
 
     _WORKER_KEYED = ("kill_worker_at_step", "hang_worker_at_step",
                      "corrupt_latest_checkpoint_at_step")
@@ -174,6 +187,9 @@ class FaultPlan:
         if d.get("resize_world_at_step") is not None:
             v = d["resize_world_at_step"]
             d["resize_world_at_step"] = (int(v[0]), int(v[1]))
+        if "net_faults" in d:
+            d["net_faults"] = {int(k): [dict(s) for s in v]
+                               for k, v in d["net_faults"].items()}
         return cls(**d)
 
     def to_json_dict(self) -> dict:

@@ -48,7 +48,12 @@ Serving trials add four more (:func:`check_serving`): 7.
 **decode_swap** (a weight swap mid-generation is licensed: a sequence
 finishing on a different model step than it started on must hold a
 journaled ``seq_restart``, and every restart must follow its
-``weight_swap``).
+``weight_swap``). Network chaos trials (launch/netchaos.py proxies)
+add 13. **net_faults** (:func:`check_net_faults`): exactly-once
+outcomes under retry amplification — duplicate server-side admits of
+one request id are legal only when licensed by a journaled retry or
+``net_*`` fault, and every ``dedup_hit`` must follow a completed
+terminal for that id on the same replica.
 
 No cluster, supervisor, or trainer state is consulted — a report over
 downloaded artifacts is as checkable as a live run, which is what lets
@@ -75,7 +80,8 @@ from .report import load_jsonl
 INVARIANTS = ("terminal_state", "metrics_log", "determinism",
               "causality", "checkpoint_integrity", "reconfigure",
               "serve_outcomes", "serve_digest", "serve_monotone",
-              "decode_swap", "serve_group", "autoscale", "discipline")
+              "decode_swap", "serve_group", "autoscale", "discipline",
+              "net_faults")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -777,7 +783,12 @@ def check_serving(trial_dir: str | Path, outcome: dict,
             f"{sorted(doubled)[:5]} — the load journal lies"))
 
     # workers the run faulted/killed/restarted: their in-flight
-    # admissions may legitimately have died server-side
+    # admissions may legitimately have died server-side. Network
+    # faults license too: the ``net_*`` actions (launch/netchaos.py
+    # proxies) journal the PROXIED replica as ``worker``, so a replica
+    # whose link was reset/partitioned/blackholed mid-request is
+    # exempt from the admit↔terminal books the same way a SIGKILLed
+    # one is — the client still owes every request a terminal.
     exempt: set[int] = set()
     for r in journal_records:
         if r.get("event") == schema.FAULT and isinstance(r.get("worker"), int):
@@ -983,6 +994,111 @@ def check_serve_group(trial_dir: str | Path
 
 
 # ---------------------------------------------------------------------------
+# (13) net_faults: exactly-once outcomes under retry amplification
+# ---------------------------------------------------------------------------
+
+def check_net_faults(trial_dir: str | Path, outcome: dict,
+                     journal_records: list[dict]
+                     ) -> tuple[list[Violation], bool]:
+    """Invariant (13), replayed from artifacts alone. Returns
+    ``(violations, applicable)`` — not applicable (verdict: skipped)
+    when the trial shows no network-fault evidence at all: no
+    journaled ``net_*`` fault, no ``dedup_hit`` in any serve journal,
+    and no retried client terminal.
+
+    Network faults (launch/netchaos.py) make requests ARRIVE more
+    than once — a mid-stream reset or partition forces the client to
+    retry an id on a sibling, or on the same replica after its
+    connection died. The hardened protocol's claim is exactly-once
+    OUTCOMES, not exactly-once arrivals, and this invariant holds the
+    books to it:
+
+    * **exactly one client terminal per issue, globally** — retry
+      amplification (``attempts`` > 1) must never surface as a second
+      terminal outcome for one id; the failover loop returns one.
+    * **duplicate admits are licensed** — a request id admitted more
+      than once across the roster (double execution) is legal only
+      when the client journaled a retry for that id or a ``net_*``
+      fault was journaled against one of the replicas involved;
+      an unlicensed duplicate admit is the server double-executing a
+      request nobody resent.
+    * **dedup hits are honest** — a ``dedup_hit`` record must FOLLOW
+      a completed terminal (``respond``/``decode_finish``) for that
+      id on the same replica, in journal order: both server paths
+      journal the terminal before populating the cache (the journal
+      lock serializes the writes), so a hit with no prior terminal is
+      a cache returning an outcome it never computed.
+    """
+    trial_dir = Path(trial_dir)
+    net_faults = [r for r in journal_records
+                  if r.get("event") == schema.FAULT
+                  and str(r.get("action", "")).startswith("net_")]
+    net_faulted = {r["worker"] for r in net_faults
+                   if isinstance(r.get("worker"), int)}
+
+    # client side: per-id issue/terminal books + retry licenses
+    load_records = load_jsonl(trial_dir / "loadgen.jsonl", schema.LOAD)
+    issued: dict[Any, int] = {}
+    terminal: dict[Any, int] = {}
+    retried_ids: set = set()
+    for r in load_records:
+        if r.get("action") == "issue":
+            issued[r.get("id")] = issued.get(r.get("id"), 0) + 1
+        elif r.get("action") == "outcome":
+            terminal[r.get("id")] = terminal.get(r.get("id"), 0) + 1
+            attempts = r.get("attempts")
+            if r.get("retried") or (isinstance(attempts, int)
+                                    and attempts > 1):
+                retried_ids.add(r.get("id"))
+
+    # server side: admits per id across the roster + dedup honesty
+    out: list[Violation] = []
+    admits_by_id: dict[Any, list[int]] = {}
+    dedup_hits = 0
+    for k, d in sorted(_worker_dirs(trial_dir).items()):
+        recs = load_jsonl(d / "serve_log.jsonl", schema.SERVE)
+        completed: set = set()  # ids with a terminal SO FAR, in order
+        for r in recs:
+            action = r.get("action")
+            if action == "admit":
+                admits_by_id.setdefault(r.get("id"), []).append(k)
+            elif action in ("respond", "decode_finish"):
+                completed.add(r.get("id"))
+            elif action == "dedup_hit":
+                dedup_hits += 1
+                if r.get("id") not in completed:
+                    out.append(Violation(
+                        "net_faults",
+                        f"dedup_hit for id {r.get('id')!r} with no "
+                        "earlier completed terminal for that id on this "
+                        "replica — the cache returned an outcome it "
+                        "never computed", k))
+
+    applicable = bool(net_faults) or dedup_hits > 0 or bool(retried_ids)
+    if not applicable:
+        return [], False
+
+    for i in sorted(issued, key=str):
+        if terminal.get(i, 0) > issued[i]:
+            out.append(Violation(
+                "net_faults",
+                f"request id {i!r} issued {issued[i]}x but reached "
+                f"{terminal[i]} terminal outcomes — retry amplification "
+                "leaked a duplicate terminal to the client"))
+    for i, ks in sorted(admits_by_id.items(), key=str):
+        if len(ks) <= 1 or i in retried_ids:
+            continue
+        if any(k in net_faulted for k in ks):
+            continue
+        out.append(Violation(
+            "net_faults",
+            f"request id {i!r} admitted {len(ks)}x (replicas "
+            f"{sorted(set(ks))}) with no journaled retry or net fault "
+            "licensing the duplicate — an unlicensed double execution"))
+    return out, True
+
+
+# ---------------------------------------------------------------------------
 # whole-run replay
 # ---------------------------------------------------------------------------
 
@@ -1081,6 +1197,14 @@ def check_run(trial_dir: str | Path, outcome: dict | None = None,
     violations += autoscale_violations
     if not autoscale_applicable:
         skipped.add("autoscale")
+    net_violations, net_applicable = check_net_faults(
+        trial_dir, outcome, journal_all)
+    violations += net_violations
+    if not net_applicable:
+        # only trials with network-fault evidence (a journaled net_*
+        # fault, a dedup hit, or a retried terminal) make the
+        # exactly-once-under-retry claim
+        skipped.add("net_faults")
 
     restarts_by_worker: dict[int, int] = {}
     for r in recovery:

@@ -326,6 +326,52 @@ def summarize_discipline(records: list[dict]) -> dict[str, Any]:
     return out
 
 
+def summarize_net_chaos(trial_dir: str | Path) -> dict[str, Any] | None:
+    """One trial's network-fault evidence, from artifacts alone: the
+    ``net_*`` fault records the chaos proxies (launch/netchaos.py)
+    journaled, the dedup-cache hits and deadline aborts the hardened
+    replicas booked, and the client-side retry amplification the load
+    journal shows. Returns ``None`` when the trial carries no network
+    evidence at all — the per-trial ``net`` slot in the chaos report
+    stays absent for non-network campaigns."""
+    trial_dir = Path(trial_dir)
+    by_kind: dict[str, int] = {}
+    for r in load_jsonl(trial_dir / "command_journal.jsonl"):
+        a = str(r.get("action", ""))
+        if r.get("event") == schema.FAULT and a.startswith("net_"):
+            by_kind[a] = by_kind.get(a, 0) + 1
+    dedup_hits = conn_aborts = 0
+    for f in sorted(trial_dir.glob("worker*/serve_log.jsonl")):
+        for r in load_jsonl(f, schema.SERVE):
+            if r.get("action") == "dedup_hit":
+                dedup_hits += 1
+            elif r.get("action") == "conn_abort":
+                conn_aborts += 1
+    attempts: list[float] = []
+    retried = terminals = 0
+    for r in load_jsonl(trial_dir / "loadgen.jsonl", schema.LOAD):
+        if r.get("action") != "outcome":
+            continue
+        terminals += 1
+        n = r.get("attempts")
+        if isinstance(n, (int, float)):
+            attempts.append(float(n))
+        if r.get("retried") or (isinstance(n, int) and n > 1):
+            retried += 1
+    if not by_kind and not dedup_hits and not retried:
+        return None
+    out: dict[str, Any] = {
+        "faults": by_kind, "fired": sum(by_kind.values()),
+        "dedup_hits": dedup_hits, "conn_aborts": conn_aborts,
+        "retried": retried,
+        "retry_rate": round(retried / max(1, terminals), 4)}
+    if attempts:
+        s = sorted(attempts)
+        out["attempts"] = {"p50": _percentile(s, 0.50),
+                           "p99": _percentile(s, 0.99), "max": s[-1]}
+    return out
+
+
 def summarize_chaos(path: str | Path) -> dict[str, Any]:
     """Aggregate a chaos campaign's ``chaos_report.jsonl`` (one
     ``event: "chaos_trial"`` record per trial, written by
@@ -345,6 +391,7 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
     serving_trials: list[dict[str, Any]] = []
     autoscale_trials: list[dict[str, Any]] = []
     discipline_trials: list[dict[str, Any]] = []
+    net_trials: list[dict[str, Any]] = []
     reconfigures = 0
     swaps_by_tier: dict[str, int] = {}
     quant_fallbacks = 0
@@ -398,6 +445,18 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
                 "by_direction": dc.get("by_direction") or {},
                 "flaps": dc.get("flaps", 0),
                 "trace": dc.get("trace") or []})
+        nt = rec.get("net")
+        if nt is not None:
+            net_trials.append({
+                "trial": rec.get("trial"),
+                "faults": nt.get("faults") or {},
+                "fired": nt.get("fired", 0),
+                "dedup_hits": nt.get("dedup_hits", 0),
+                "conn_aborts": nt.get("conn_aborts", 0),
+                "retried": nt.get("retried", 0),
+                "retry_rate": nt.get("retry_rate"),
+                "attempts_p50": (nt.get("attempts") or {}).get("p50"),
+                "attempts_p99": (nt.get("attempts") or {}).get("p99")})
         f = rec.get("faults")
         if f is not None:
             fault_trials.append({"trial": rec.get("trial"),
@@ -532,7 +591,32 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
                     if not d.startswith("tighten")),
                 "flaps": sum(t["flaps"] or 0 for t in discipline_trials),
                 "per_trial": discipline_trials}
-                if discipline_trials else None)}
+                if discipline_trials else None),
+            # network-mode campaigns: the transport-fault evidence per
+            # trial and in aggregate — faults by kind, dedup-cache
+            # hits (the exactly-once proof), retry amplification —
+            # the nightly network gate asserts faults fired (incl. a
+            # mid-stream reset), dropped==0, and invariant 13 green
+            "net": ({
+                "trials": len(net_trials),
+                "fired": sum(t["fired"] or 0 for t in net_trials),
+                "faults_by_kind": {
+                    k: sum((t["faults"] or {}).get(k, 0)
+                           for t in net_trials)
+                    for t2 in net_trials for k in (t2["faults"] or {})},
+                "dedup_hits": sum(t["dedup_hits"] or 0
+                                  for t in net_trials),
+                "conn_aborts": sum(t["conn_aborts"] or 0
+                                   for t in net_trials),
+                "retried": sum(t["retried"] or 0 for t in net_trials),
+                "attempts_p50": max(
+                    (t["attempts_p50"] for t in net_trials
+                     if t["attempts_p50"] is not None), default=None),
+                "attempts_p99": max(
+                    (t["attempts_p99"] for t in net_trials
+                     if t["attempts_p99"] is not None), default=None),
+                "per_trial": net_trials}
+                if net_trials else None)}
 
 
 def summarize_journal(path: str | Path) -> dict[str, Any]:

@@ -196,6 +196,13 @@ _declare(EventSchema(
         "respond": _act(("id", "model_step", "tier", "batch", "bucket",
                          "latency_ms")),
         "reject": _act(("id", "reason", "admitted")),
+        # a retried request whose terminal is already cached: the
+        # server returns the cached payload WITHOUT re-executing — the
+        # exactly-once evidence invariant 13 (net_faults) requires
+        "dedup_hit": _act(("id", "status"), ("age_s",)),
+        # a connection closed by the read/write deadline or half-open
+        # detection BEFORE any admit — no terminal is owed for it
+        "conn_abort": _act(("reason",), ("bytes_read", "id")),
         "weight_swap": _act(("step", "from_step", "digest", "tier",
                              "source_artifact", "source_digest",
                              "swap_ms"),
@@ -297,7 +304,7 @@ _declare(EventSchema(
         "issue": _act(("id",)),
         "outcome": _act(("id", "status"),
                         ("reason", "model_step", "tier", "attempts",
-                         "endpoint", "latency_ms",
+                         "retried", "endpoint", "latency_ms",
                          # decode sweeps: the two-number latency split
                          "ttft_ms", "itl_ms", "tokens")),
         # rolling-window snapshot over the last ``window_s`` seconds:
@@ -305,12 +312,17 @@ _declare(EventSchema(
         "window": _act(("window_s", "terminal", "responses",
                         "rejected", "errors", "reject_rate"),
                        ("issued", "p50_ms", "p99_ms", "ttft_p50_ms",
-                        "ttft_p99_ms", "throughput_rps")),
+                        "ttft_p99_ms", "throughput_rps", "retried",
+                        "retry_rate")),
     },
 ))
 
-# Fault-injector firings (launch/cluster.py) — the exemption evidence
-# the replay invariants match violations against.
+# Fault-injector firings (launch/cluster.py process/disk faults,
+# launch/netchaos.py transport faults) — the exemption evidence the
+# replay invariants match violations against.  The ``net_*`` actions
+# are the chaos proxy's journal: ``worker`` is the PROXIED replica (so
+# the serve_outcomes faulted-replica exemption auto-covers it) and
+# ``conn`` its per-proxy connection ordinal.
 _declare(EventSchema(
     FAULT,
     required=("action", "worker"),
@@ -321,6 +333,14 @@ _declare(EventSchema(
                               "planned_step")),
         "corrupt_latest_checkpoint": _act(("at_step", "planned_step"),
                                           ("target", "truncated_to")),
+        # -- transport faults (launch/netchaos.py ChaosProxy) ----------
+        "net_latency": _act(("delay_ms", "jitter_ms"), ("conn",)),
+        "net_bandwidth": _act(("bytes_per_s",), ("conn",)),
+        "net_reset": _act(("after_bytes",),
+                          ("conn", "bytes_passed", "mid_stream")),
+        "net_blackhole": _act(("hold_s",), ("conn",)),
+        "net_partition": _act(("start_s", "duration_s"),
+                              ("conns_dropped",)),
     },
 ))
 
@@ -352,7 +372,7 @@ _declare(EventSchema(
               "step", "target", "duration_s", "verdicts", "violations"),
     optional=("mttr", "boot_s", "stall_timeout_s", "faults",
               "reconfigures", "final_world", "serving", "serve_swaps",
-              "shrunk", "broker", "autoscale", "discipline"),
+              "shrunk", "broker", "autoscale", "discipline", "net"),
 ))
 
 # Continuous evaluator (evalsvc/evaluator.py eval_log.jsonl).

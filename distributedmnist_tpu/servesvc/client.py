@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import random
 import socket
 import threading
 import time
@@ -66,25 +67,74 @@ class ServeClient:
                  endpoints: (list[dict] | list[tuple]
                              | Callable[[], list[dict]]),
                  deadline_s: float = 2.0, max_attempts: int = 4,
-                 backoff_s: float = 0.05):
+                 backoff_s: float = 0.05,
+                 quarantine_s: float = 0.25,
+                 quarantine_max_s: float = 5.0, seed: int = 0):
         self._endpoints_fn = (endpoints if callable(endpoints)
                               else (lambda: endpoints))
         self.deadline_s = deadline_s
         self.max_attempts = max_attempts
         self.backoff_s = backoff_s
+        # partition-aware endpoint quarantine: an endpoint whose
+        # attempt failed at the TRANSPORT (refused, reset, timed out —
+        # a partitioned or half-open link) is benched for a jittered,
+        # exponentially-growing window so retries stop stampeding the
+        # dead link; any success clears it, and when EVERY endpoint is
+        # benched the rotation ignores the bench entirely (quarantine
+        # narrows the search, it never causes a total lockout).
+        self.quarantine_s = quarantine_s
+        self.quarantine_max_s = quarantine_max_s
+        self._rng = random.Random(seed)
+        self._quarantined_until: dict[tuple[str, int], float] = {}
+        self._failures: dict[tuple[str, int], int] = {}
         self._rr = itertools.count()
         self._lock = threading.Lock()
+
+    @staticmethod
+    def _as_ep(ep) -> tuple[str, int]:
+        if isinstance(ep, dict):
+            return ep["host"], int(ep["port"])
+        return ep[0], int(ep[1])
 
     def _next_endpoint(self) -> tuple[str, int] | None:
         eps = self._endpoints_fn()
         if not eps:
             return None
+        now = time.monotonic()
         with self._lock:
+            live = [e for e in eps
+                    if self._quarantined_until.get(self._as_ep(e), 0.0)
+                    <= now]
+            pool = live or eps
             i = next(self._rr)
-        ep = eps[i % len(eps)]
-        if isinstance(ep, dict):
-            return ep["host"], int(ep["port"])
-        return ep[0], int(ep[1])
+        return self._as_ep(pool[i % len(pool)])
+
+    def _jitter(self) -> float:
+        with self._lock:
+            return 1.0 + 0.25 * (2.0 * self._rng.random() - 1.0)
+
+    def _note_failure(self, host: str, port: int) -> None:
+        ep = (host, port)
+        with self._lock:
+            n = self._failures.get(ep, 0) + 1
+            self._failures[ep] = n
+            hold = min(self.quarantine_max_s,
+                       self.quarantine_s * 2.0 ** (n - 1))
+            hold *= 1.0 + 0.25 * (2.0 * self._rng.random() - 1.0)
+            self._quarantined_until[ep] = time.monotonic() + hold
+
+    def _note_success(self, host: str, port: int) -> None:
+        ep = (host, port)
+        with self._lock:
+            self._failures.pop(ep, None)
+            self._quarantined_until.pop(ep, None)
+
+    def quarantined(self) -> list[tuple[str, int]]:
+        """Endpoints currently benched (for tests/introspection)."""
+        now = time.monotonic()
+        with self._lock:
+            return sorted(ep for ep, t in self._quarantined_until.items()
+                          if t > now)
 
     def _one_attempt(self, payload: bytes, host: str, port: int,
                      timeout_s: float) -> dict:
@@ -132,23 +182,30 @@ class ServeClient:
             try:
                 resp = attempt(host, port, remaining, attempts, t0)
             except (OSError, ValueError) as e:
+                # transport-level failure: quarantine the endpoint
+                # (partition-aware — the next attempts rotate PAST the
+                # dead link) and back off with seeded jitter so N
+                # retrying clients don't re-stampede in lockstep
                 logger.debug("attempt %d via %s:%d failed: %s",
                              attempts, host, port, e)
-                time.sleep(min(self.backoff_s * attempts,
+                self._note_failure(host, port)
+                time.sleep(min(self.backoff_s * attempts * self._jitter(),
                                max(0.0, deadline - time.time())))
                 continue
+            self._note_success(host, port)
             status = resp.get("status")
             out = {**resp, "attempts": attempts,
+                   "retried": attempts > 1,
                    "endpoint": f"{host}:{port}",
                    "latency_ms": round((time.time() - t0) * 1e3, 3)}
             if (status == "rejected"
                     and resp.get("reason") in RETRYABLE_REJECTS):
-                time.sleep(min(self.backoff_s * attempts,
+                time.sleep(min(self.backoff_s * attempts * self._jitter(),
                                max(0.0, deadline - time.time())))
                 continue
             return out  # ok / typed non-retryable / unknown: terminal
         return {"id": request_id, "status": "error", "reason": last_reason,
-                "attempts": attempts,
+                "attempts": attempts, "retried": attempts > 1,
                 "latency_ms": round((time.time() - t0) * 1e3, 3)}
 
     def request(self, inputs, request_id=None,

@@ -517,11 +517,16 @@ class DecodeReplica(ServingReplica):
         if s.restarts:
             fields["restarts"] = s.restarts
         self._terminal("decode_finish", s.req_id, **fields)
-        self._respond(s.conn, {
+        payload = {
             "id": s.req_id, "status": "ok",
             "tokens": [int(t) for t in s.tokens],
             "finish_reason": reason, "model_step": s.params_step,
-            "started_step": s.started_step})
+            "started_step": s.started_step}
+        # idempotency: a mid-stream reset that ate this terminal makes
+        # the retry a dedup hit carrying the SAME completed tokens —
+        # the generation never runs twice for one request id
+        self._dedup_put(s.req_id, payload)
+        self._respond(s.conn, payload)
         self._slots[i] = None
         self.cache.free_sequence(s.block_table)
         self._bump_tables_epoch()

@@ -137,6 +137,9 @@ def run_load(client: ServeClient, num_requests: int | None,
                    # loadgen artifact's record of what a sweep measured
                    "tier": got.get("tier"),
                    "attempts": got.get("attempts"),
+                   # retry amplification is measured, not inferred:
+                   # True exactly when the terminal took > 1 attempt
+                   "retried": bool(got.get("retried")),
                    "endpoint": got.get("endpoint"),
                    "latency_ms": got.get("latency_ms")}
             if decode:
@@ -198,6 +201,7 @@ def summarize_window(outcomes: list[dict], issued: int, now: float,
     ok = [r for r in recent if r.get("status") == "ok"]
     rejected = [r for r in recent if r.get("status") == "rejected"]
     errors = [r for r in recent if r.get("status") == "error"]
+    retried = [r for r in recent if r.get("retried")]
     out: dict[str, Any] = {
         "window_s": window_s,
         "issued": issued,
@@ -206,6 +210,10 @@ def summarize_window(outcomes: list[dict], issued: int, now: float,
         "rejected": len(rejected),
         "errors": len(errors),
         "reject_rate": round(len(rejected) / max(1, len(recent)), 4),
+        # retry amplification under faults, surfaced live: the share
+        # of window terminals that needed more than one attempt
+        "retried": len(retried),
+        "retry_rate": round(len(retried) / max(1, len(recent)), 4),
         "throughput_rps": round(len(recent) / max(window_s, 1e-9), 2),
     }
     lat = sorted(r["latency_ms"] for r in ok
@@ -276,6 +284,9 @@ def summarize_outcomes(outcomes: list[dict], issued: int,
         "rejected": len(rejected),
         "errors": len(errors),
         "by_reason": by_reason,
+        # terminals that took >1 attempt — under net faults this is the
+        # retry amplification the dedup cache must absorb
+        "retried": sum(1 for r in outcomes if r.get("retried")),
         "reject_rate": round(len(rejected) / max(1, len(outcomes)), 4),
         "duration_s": round(duration_s, 3),
         "throughput_rps": round(len(outcomes) / max(duration_s, 1e-9), 2),

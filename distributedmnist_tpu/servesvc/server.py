@@ -56,6 +56,7 @@ Artifacts per replica (in ``serve_dir``):
 
 from __future__ import annotations
 
+import collections
 import json
 import queue
 import socket
@@ -217,6 +218,18 @@ class ServingReplica:
         self._last_heartbeat = -1
         self.swaps = 0
 
+        # idempotency: request id → (final ok payload, completed_at).
+        # A retried request whose execution already completed here —
+        # the sibling-failover case, or a reset that ate the response
+        # after _terminal journaled it — answers from this cache
+        # instead of double-executing (journaled as ``dedup_hit``).
+        # Bounded LRU; only FINAL ok outcomes are cached (retryable
+        # sheds must stay retryable).
+        self._dedup_lock = threading.Lock()
+        self._dedup: collections.OrderedDict[Any, tuple[dict, float]] = \
+            collections.OrderedDict()
+        self.dedup_hits = 0
+
     # -- journal ------------------------------------------------------
 
     def _journal(self, record: dict) -> None:
@@ -233,6 +246,28 @@ class ServingReplica:
         self._journal({"action": action, "id": req_id, **fields})
         with self._journal_lock:
             self._terminals += 1
+
+    # -- idempotency / dedup cache ------------------------------------
+
+    def _dedup_put(self, req_id, payload: dict) -> None:
+        """Remember a FINAL ok outcome for its request id. Ids are the
+        client's idempotency keys; requests without one opt out."""
+        if req_id is None or int(self.scfg.dedup_cache_size) <= 0:
+            return
+        with self._dedup_lock:
+            self._dedup[req_id] = (payload, time.time())
+            self._dedup.move_to_end(req_id)
+            while len(self._dedup) > int(self.scfg.dedup_cache_size):
+                self._dedup.popitem(last=False)
+
+    def _dedup_get(self, req_id) -> tuple[dict, float] | None:
+        if req_id is None:
+            return None
+        with self._dedup_lock:
+            got = self._dedup.get(req_id)
+            if got is not None:
+                self._dedup.move_to_end(req_id)
+        return got
 
     def _pressure_fields(self) -> dict:
         """Live replica pressure stamped onto every heartbeat — queue
@@ -451,6 +486,14 @@ class ServingReplica:
 
     def _respond(self, conn, payload: dict) -> bool:
         try:
+            # write deadline: a peer that stopped reading (half-open,
+            # partitioned) costs at most conn_write_timeout_s, never a
+            # wedged batcher — the tighter per-connection timeout the
+            # decode loop sets stays in force
+            wt = float(self.scfg.conn_write_timeout_s)
+            cur = conn.gettimeout()
+            if wt > 0 and (cur is None or cur > wt):
+                conn.settimeout(wt)
             conn.sendall((json.dumps(payload) + "\n").encode())
             return True
         except OSError:
@@ -484,21 +527,57 @@ class ServingReplica:
                 "tier_source_digest": self.model_source_digest,
                 "max_batch": self.scfg.max_batch}
 
+    def _conn_abort(self, conn, reason: str, bytes_read: int) -> None:
+        """Close a connection that never became a request — the read
+        deadline fired or the peer went half-open. Nothing was
+        admitted, so no terminal outcome is owed; the abort is
+        journaled so the books explain the closed socket."""
+        self._journal({"action": "conn_abort", "reason": reason,
+                       "bytes_read": bytes_read})
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _read_request(self, conn) -> bytes | None:
+        """Read one request line under a TOTAL deadline — a slowloris
+        peer trickling bytes (or sending none: the half-open case)
+        costs one bounded stall of at most ``conn_read_timeout_s``,
+        then the connection is aborted. Returns None when aborted."""
+        total_s = max(0.1, float(self.scfg.conn_read_timeout_s))
+        deadline = time.monotonic() + total_s
+        buf = b""
+        while b"\n" not in buf:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self._conn_abort(conn,
+                                 "half_open" if not buf
+                                 else "read_deadline", len(buf))
+                return None
+            conn.settimeout(remaining)
+            try:
+                chunk = conn.recv(65536)
+            except socket.timeout:
+                self._conn_abort(conn,
+                                 "half_open" if not buf
+                                 else "read_deadline", len(buf))
+                return None
+            if not chunk:
+                break
+            buf += chunk
+            if len(buf) > _MAX_REQUEST_BYTES:
+                self._reject(conn, None, "bad_request", admitted=False)
+                return None
+        return buf
+
     def _handle_conn(self, conn) -> None:
         """Read one request; admit it (or shed typed). Runs on a
         per-connection thread so a slow client can't stall admission."""
         req_id = None
         try:
-            conn.settimeout(5.0)
-            buf = b""
-            while b"\n" not in buf:
-                chunk = conn.recv(65536)
-                if not chunk:
-                    break
-                buf += chunk
-                if len(buf) > _MAX_REQUEST_BYTES:
-                    self._reject(conn, None, "bad_request", admitted=False)
-                    return
+            buf = self._read_request(conn)
+            if buf is None:
+                return  # _read_request aborted or rejected
             try:
                 req = json.loads(buf.decode())
                 if not isinstance(req, dict):
@@ -510,6 +589,20 @@ class ServingReplica:
                 self._respond(conn, self._meta())
                 return
             req_id = req.get("id")
+            cached = self._dedup_get(req_id)
+            if cached is not None:
+                # this id already ran to a final outcome here (the
+                # retry's first attempt, on this replica, before a
+                # reset ate the response): answer from the cache —
+                # exactly-once means never double-executing
+                payload, done_at = cached
+                with self._journal_lock:
+                    self.dedup_hits += 1
+                self._journal({"action": "dedup_hit", "id": req_id,
+                               "status": payload.get("status"),
+                               "age_s": round(time.time() - done_at, 3)})
+                self._respond(conn, payload)
+                return
             if self._stop.is_set():
                 self._reject(conn, req_id, "shutting_down", admitted=False)
                 return
@@ -629,11 +722,15 @@ class ServingReplica:
                 "respond", it.req_id, model_step=step, tier=tier,
                 batch=len(live), bucket=bucket,
                 latency_ms=round((time.time() - it.admitted_at) * 1e3, 3))
-            self._respond(it.conn, {
+            payload = {
                 "id": it.req_id, "status": "ok", "model_step": step,
                 "model_digest": digest, "tier": tier,
                 "prediction": int(np.argmax(p)),
-                "probs": [round(float(v), 6) for v in p]})
+                "probs": [round(float(v), 6) for v in p]}
+            # cache BEFORE sending: if the send dies mid-wire (reset,
+            # partition) the retry finds the completed outcome here
+            self._dedup_put(it.req_id, payload)
+            self._respond(it.conn, payload)
 
     def _batch_loop(self) -> None:
         while not self._stop.is_set():

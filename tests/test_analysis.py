@@ -24,7 +24,8 @@ from distributedmnist_tpu.analysis import (CHECKERS, iter_sources,
                                            load_baseline, run_checkers)
 from distributedmnist_tpu.analysis.core import Source
 from distributedmnist_tpu.analysis import (config_check, jax_check,
-                                           schema_check, threads_check)
+                                           net_check, schema_check,
+                                           threads_check)
 from distributedmnist_tpu.obsv import schema
 
 REPO = Path(__file__).resolve().parents[1]
@@ -151,7 +152,12 @@ class TestConfigChecker:
         got = keys(config_check.check(srcs))
         for knob in ("decode.attention_kernel", "serve.tp_ranks",
                      "serve.tp_group_max_restarts",
-                     "serve.tp_group_poll_secs"):
+                     "serve.tp_group_poll_secs",
+                     # the protocol-hardening knobs: consumed by the
+                     # replica's conn threads and dedup cache
+                     "serve.conn_read_timeout_s",
+                     "serve.conn_write_timeout_s",
+                     "serve.dedup_cache_size"):
             assert not any(f"dead.{knob}" in k for k in got), knob
         bad = src("distributedmnist_tpu/servesvc/snippet.py",
                   "def f(cfg):\n    return cfg.serve.tp_rankz\n")
@@ -211,6 +217,66 @@ class TestPagedChecker:
         from distributedmnist_tpu.analysis import paged_check
         srcs = iter_sources([PKG / "servesvc"], repo_root=REPO)
         got = paged_check.check(srcs)
+        assert got == [], [f.key for f in got]
+
+
+# ---------------------------------------------------------------------------
+# net checker fixtures (socket-deadline lint, servesvc/ + launch/ scope)
+# ---------------------------------------------------------------------------
+
+class TestNetChecker:
+    def check(self, text: str,
+              path: str = "distributedmnist_tpu/servesvc/snippet.py"):
+        return net_check.check([src(path, text)])
+
+    def test_recv_without_timeout_flagged(self):
+        got = self.check(
+            "class Replica:\n"
+            "    def _read(self, conn):\n"
+            "        return conn.recv(65536)\n")
+        assert any("Replica._read.recv" in k for k in keys(got))
+
+    def test_class_level_settimeout_clears_all_methods(self):
+        # the listener idiom: settimeout in start(), accept elsewhere —
+        # evidence is class-scoped, so the sibling method is clean
+        got = self.check(
+            "class Replica:\n"
+            "    def start(self, sock):\n"
+            "        sock.settimeout(0.2)\n"
+            "    def _accept_loop(self, sock):\n"
+            "        conn, addr = sock.accept()\n"
+            "        return conn.recv(65536)\n")
+        assert got == []
+
+    def test_create_connection_without_timeout_flagged(self):
+        got = self.check(
+            "import socket\n"
+            "def dial(host, port):\n"
+            "    return socket.create_connection((host, port))\n")
+        assert any("dial.create_connection" in k for k in keys(got))
+
+    def test_create_connection_with_timeout_clean(self):
+        # kwarg or 2nd positional arg both bound the connect
+        for call in ("socket.create_connection((h, p), timeout=1.0)",
+                     "socket.create_connection((h, p), 1.0)"):
+            got = self.check(
+                f"import socket\ndef dial(h, p):\n    return {call}\n")
+            assert got == [], call
+
+    def test_other_trees_and_tests_exempt(self):
+        bad = ("class C:\n"
+               "    def f(self, conn):\n"
+               "        return conn.recv(1)\n")
+        assert self.check(
+            bad, path="distributedmnist_tpu/models/net.py") == []
+        assert self.check(bad, path="tests/test_x.py") == []
+
+    def test_real_wire_paths_are_clean(self):
+        # the lint's reason to exist: every blocking socket op the
+        # serving/launch stack ships today is deadline-bounded
+        srcs = iter_sources([PKG / "servesvc", PKG / "launch"],
+                            repo_root=REPO)
+        got = net_check.check(srcs)
         assert got == [], [f.key for f in got]
 
 
@@ -575,7 +641,7 @@ class TestSelfCheck:
     def test_all_checkers_registered(self):
         run_checkers([])  # force registration imports
         assert set(CHECKERS) == {"schema", "config", "threads", "jax",
-                                 "paged"}
+                                 "paged", "net"}
 
     def test_baseline_entries_carry_justifications(self):
         raw = json.loads(

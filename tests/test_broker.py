@@ -195,6 +195,21 @@ def test_tail_heartbeat_skips_torn_tail(tmp_path):
     assert tail_heartbeat(tmp_path / "missing") is None
 
 
+def test_tail_heartbeat_empty_file(tmp_path):
+    # a replica that crashed before its first heartbeat leaves an
+    # empty log — no heartbeat is the answer, not an exception
+    (tmp_path / "train_log.jsonl").write_text("")
+    assert tail_heartbeat(tmp_path) is None
+
+
+def test_tail_heartbeat_all_lines_torn(tmp_path):
+    # a partition can tear EVERY buffered line (half-written page):
+    # the backward scan must walk off the top and report nothing
+    (tmp_path / "train_log.jsonl").write_text(
+        '{"event": "heartbeat", "st\n{"event": "heartbeat"')
+    assert tail_heartbeat(tmp_path) is None
+
+
 # ---------------------------------------------------------------------------
 # ResourceBroker.execute over a scripted roster backend
 # ---------------------------------------------------------------------------

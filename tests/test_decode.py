@@ -265,6 +265,9 @@ class StubConn:
     def settimeout(self, t):
         pass
 
+    def gettimeout(self):
+        return None
+
     def sendall(self, b):
         for line in b.decode().splitlines():
             self.lines.append(json.loads(line))
@@ -306,7 +309,10 @@ def test_decode_replica_streams_and_batches_end_to_end(lm_published,
         assert meta["decode"] is True and meta["vocab_size"] == 32
         assert meta["model_step"] == 10
         streamed = []
-        out = client.generate([1, 2, 3, 4, 5], request_id=1,
+        # ids 100/101: the loadgen below issues ids 0..11, and a reused
+        # id is now a DUPLICATE the dedup cache answers from the first
+        # execution — colliding would hide two of the 14 executions
+        out = client.generate([1, 2, 3, 4, 5], request_id=100,
                               max_tokens=6,
                               on_token=lambda r: streamed.append(
                                   r.get("token")))
@@ -315,7 +321,7 @@ def test_decode_replica_streams_and_batches_end_to_end(lm_published,
         assert len(out["tokens"]) == 6 and streamed == out["tokens"]
         assert out["ttft_ms"] is not None
         # greedy determinism: the same prompt generates the same tokens
-        out2 = client.generate([1, 2, 3, 4, 5], request_id=2,
+        out2 = client.generate([1, 2, 3, 4, 5], request_id=101,
                                max_tokens=6)
         assert out2["tokens"] == out["tokens"]
         # continuous batching: 3 slots, 12 concurrent requests of

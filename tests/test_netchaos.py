@@ -1,0 +1,412 @@
+"""Network chaos proxy (launch/netchaos.py) + the exactly-once books
+it exists to exercise (ISSUE 19).
+
+Four layers:
+
+* the proxy's fault scripts against a stub upstream — latency journals
+  and delays, the one-shot reset cuts at EXACTLY ``after_bytes`` then
+  heals, the blackhole holds one connection while siblings flow, the
+  partition window arms at FIRST live traffic (not proxy boot) and
+  heals after ``duration_s``;
+* the network schedule grammar — deterministic in (seed, trial),
+  always one mid-stream reset + one partition, bounded intensity —
+  and its FaultPlan JSON round-trip (the shrunk-reproducer format);
+* ``summarize_net_chaos`` over handcrafted artifacts;
+* invariant 13 (``check_net_faults``) both ways: a retried id absorbed
+  as a dedup hit passes; leaked duplicate terminals, dishonest dedup
+  hits, and unlicensed double executions each fail.
+
+Every record the proxy journals is run through the event-schema
+validator — the proxy is an emitter like any other.
+"""
+
+import json
+import socket
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from distributedmnist_tpu.launch.netchaos import (ChaosProxy,
+                                                  NetChaosError)
+from distributedmnist_tpu.obsv import schema
+
+
+class EchoUpstream:
+    """Line-oriented stub replica: reads one ``\\n``-terminated line
+    per connection, answers with ``reply`` (default: echo the line),
+    closes. Accepts any number of connections, each on its own
+    thread."""
+
+    def __init__(self, reply: bytes | None = None):
+        self.reply = reply
+        self.sock = socket.create_server(("127.0.0.1", 0))
+        self.sock.settimeout(0.1)
+        self.port = self.sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._accept, daemon=True)
+        self._t.start()
+
+    def _accept(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except TimeoutError:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        with conn:
+            conn.settimeout(5.0)
+            buf = b""
+            try:
+                while b"\n" not in buf:
+                    chunk = conn.recv(4096)
+                    if not chunk:
+                        return
+                    buf += chunk
+                conn.sendall(self.reply if self.reply is not None
+                             else buf)
+            except OSError:
+                pass
+
+    def close(self):
+        self._stop.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self._t.join(timeout=5)
+
+
+def _exchange(port: int, payload: bytes = b"ping\n",
+              timeout: float = 5.0) -> bytes:
+    """One request through the proxy; returns all bytes until EOF or
+    reset (partial bytes on reset, not an exception)."""
+    got = b""
+    try:
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=timeout) as conn:
+            conn.settimeout(timeout)
+            conn.sendall(payload)
+            while True:
+                chunk = conn.recv(4096)
+                if not chunk:
+                    break
+                got += chunk
+    except OSError:
+        pass  # an RST at ANY point yields the partial bytes, not a raise
+    return got
+
+
+def _assert_conforming(records):
+    for r in records:
+        assert schema.validate_event(r) == [], r
+
+
+def test_unknown_script_kind_rejected():
+    with pytest.raises(NetChaosError):
+        ChaosProxy(("127.0.0.1", 1), [{"kind": "wormhole"}], worker=0)
+
+
+def test_passthrough_latency_delays_and_journals_once():
+    up = EchoUpstream()
+    journal: list[dict] = []
+    proxy = ChaosProxy(("127.0.0.1", up.port),
+                       [{"kind": "latency", "delay_ms": 80.0,
+                         "jitter_ms": 20.0}],
+                       worker=3, journal=journal.append, seed=7)
+    try:
+        port = proxy.start()
+        t0 = time.monotonic()
+        assert _exchange(port) == b"ping\n"
+        assert time.monotonic() - t0 >= 0.08
+        _exchange(port)  # second conn: delayed again, journaled once
+        lats = [r for r in journal if r["action"] == "net_latency"]
+        assert len(lats) == 1 and lats[0]["worker"] == 3
+        assert lats[0]["delay_ms"] == 80.0
+        _assert_conforming(journal)
+    finally:
+        proxy.stop()
+        up.close()
+
+
+def test_reset_cuts_at_exact_byte_once_then_heals():
+    up = EchoUpstream(reply=b"x" * 512)
+    journal: list[dict] = []
+    proxy = ChaosProxy(("127.0.0.1", up.port),
+                       [{"kind": "reset", "after_bytes": 100}],
+                       worker=1, journal=journal.append)
+    try:
+        port = proxy.start()
+        # the cut is mid-stream and byte-exact: the client saw SOME of
+        # the response (the dangerous case — the server committed the
+        # outcome) but not all of it
+        assert len(_exchange(port)) == 100
+        rst = [r for r in journal if r["action"] == "net_reset"]
+        assert len(rst) == 1
+        assert rst[0]["bytes_passed"] == 100 and rst[0]["mid_stream"]
+        # one-shot: the retry (a fresh connection) gets the full reply
+        assert _exchange(port) == b"x" * 512
+        assert len([r for r in journal
+                    if r["action"] == "net_reset"]) == 1
+        _assert_conforming(journal)
+    finally:
+        proxy.stop()
+        up.close()
+
+
+def test_blackhole_holds_one_conn_while_sibling_flows():
+    up = EchoUpstream()
+    journal: list[dict] = []
+    proxy = ChaosProxy(("127.0.0.1", up.port),
+                       [{"kind": "blackhole", "conn": 0,
+                         "hold_s": 1.5}],
+                       worker=1, journal=journal.append)
+    try:
+        port = proxy.start()
+        # conn ordinal 0: swallowed — no bytes ever come back
+        victim = socket.create_connection(("127.0.0.1", port),
+                                          timeout=5.0)
+        victim.settimeout(0.4)
+        victim.sendall(b"ping\n")
+        with pytest.raises(TimeoutError):
+            victim.recv(4096)
+        # a half-open peer must not wedge the proxy: conn 1 flows
+        assert _exchange(port) == b"ping\n"
+        bh = [r for r in journal if r["action"] == "net_blackhole"]
+        assert len(bh) == 1 and bh[0]["conn"] == 0
+        _assert_conforming(journal)
+        victim.close()
+    finally:
+        proxy.stop()
+        up.close()
+
+
+def test_partition_arms_on_first_conn_cuts_then_heals():
+    up = EchoUpstream()
+    journal: list[dict] = []
+    proxy = ChaosProxy(("127.0.0.1", up.port),
+                       [{"kind": "partition", "start_s": 0.4,
+                         "duration_s": 0.6}],
+                       worker=1, journal=journal.append)
+    try:
+        port = proxy.start()
+        # idle well past start_s: the window must NOT have opened —
+        # its clock arms at the first accepted connection
+        time.sleep(0.6)
+        t0 = time.monotonic()
+        assert _exchange(port) == b"ping\n"
+        assert journal == []
+        # inside [t0+0.4, t0+1.0): the link is down with an RST, not
+        # a hang — the client's retry loop sees it immediately
+        time.sleep(max(0.0, t0 + 0.7 - time.monotonic()))
+        assert _exchange(port) == b""
+        part = [r for r in journal if r["action"] == "net_partition"]
+        assert len(part) == 1 and part[0]["duration_s"] == 0.6
+        # after the window: healed
+        time.sleep(max(0.0, t0 + 1.2 - time.monotonic()))
+        assert _exchange(port) == b"ping\n"
+        _assert_conforming(journal)
+    finally:
+        proxy.stop()
+        up.close()
+
+
+def test_serve_json_resolver_follows_restart(tmp_path):
+    up = EchoUpstream()
+    ep = tmp_path / "serve.json"
+    ep.write_text('{"host": "127.0.0.1", "po')  # torn ready-file
+    proxy = ChaosProxy(ep, [], worker=1)
+    try:
+        port = proxy.start()
+        # unresolvable upstream: the connection is refused (RST), the
+        # client's failover treats it like a dead replica
+        assert _exchange(port) == b""
+        # the replica "restarts" onto a new port; re-resolved per conn
+        ep.write_text(json.dumps({"host": "127.0.0.1",
+                                  "port": up.port}))
+        assert _exchange(port) == b"ping\n"
+    finally:
+        proxy.stop()
+        up.close()
+
+
+# ---------------------------------------------------------------------------
+# schedule grammar + FaultPlan round-trip
+# ---------------------------------------------------------------------------
+
+def test_network_schedule_grammar_and_determinism():
+    from distributedmnist_tpu.launch.chaos import (
+        generate_network_schedule)
+
+    a = generate_network_schedule(7, 3, [1, 2], max_faults=3)
+    b = generate_network_schedule(7, 3, [1, 2], max_faults=3)
+    assert a == b
+    kinds_seen = set()
+    for seed in range(5):
+        for t in range(10):
+            s = generate_network_schedule(seed, t, [1, 2], max_faults=3)
+            kinds = [f.kind for f in s.faults]
+            kinds_seen.update(kinds)
+            # the two mandatory scripts, exactly once each
+            assert kinds.count("net_reset") == 1
+            assert kinds.count("net_partition") == 1
+            # at most one script of a kind per worker, bounded
+            # intensity, every kind a net kind on a serve worker
+            kw = [(f.kind, f.worker) for f in s.faults]
+            assert len(kw) == len(set(kw))
+            assert 2 <= len(s.faults) <= 3
+            for f in s.faults:
+                assert f.kind.startswith("net_")
+                assert f.worker in (1, 2)
+                net = dict(f.net)
+                if f.kind == "net_reset":
+                    # above any meta/classifier response, inside a
+                    # decode stream: the cut is always mid-generation
+                    assert 450 <= net["after_bytes"] <= 800
+                elif f.kind == "net_partition":
+                    assert 1.0 <= net["start_s"] <= 4.0
+                    assert 0.75 <= net["duration_s"] <= 2.0
+                elif f.kind == "net_latency":
+                    assert 10.0 <= net["delay_ms"] <= 60.0
+                elif f.kind == "net_bandwidth":
+                    assert net["bytes_per_s"] >= 8_192
+    assert "net_latency" in kinds_seen or "net_bandwidth" in kinds_seen
+
+
+def test_network_schedule_fault_plan_roundtrip(tmp_path):
+    from distributedmnist_tpu.launch.chaos import (
+        generate_network_schedule)
+    from distributedmnist_tpu.launch.exec import FaultPlan
+
+    s = generate_network_schedule(0, 0, [1, 2], max_faults=3)
+    plan = s.to_fault_plan()
+    assert plan.net_faults, "net schedules must produce proxy scripts"
+    for worker, scripts in plan.net_faults.items():
+        assert worker in (1, 2)
+        for sc in scripts:
+            # proxy-script kinds are UNprefixed (netchaos grammar)
+            assert sc["kind"] in ("latency", "bandwidth", "reset",
+                                  "blackhole", "partition")
+    p = tmp_path / "plan.json"
+    p.write_text(json.dumps(plan.to_json_dict()))
+    assert FaultPlan.from_file(p) == plan
+
+
+# ---------------------------------------------------------------------------
+# artifacts: the net aggregate + invariant 13
+# ---------------------------------------------------------------------------
+
+def _write_jsonl(path: Path, records) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+
+
+def _net_trial(tmp_path, *, leak_terminal=False, dishonest_dedup=False,
+               double_exec=False) -> tuple[Path, list[dict]]:
+    """A handcrafted network trial: id 1 was reset mid-response, the
+    client retried, the replica's dedup cache absorbed the replay."""
+    trial = tmp_path / "trial"
+    journal = [{"event": "fault", "action": "net_reset", "worker": 1,
+                "after_bytes": 500, "bytes_passed": 500,
+                "mid_stream": True, "conn": 0, "ts": 50.0}]
+    load = [{"event": "load", "action": "issue", "id": i, "time": 1.0 + i}
+            for i in range(3)]
+    load += [{"event": "load", "action": "outcome", "id": i,
+              "status": "ok", "attempts": 2 if i == 1 else 1,
+              "retried": i == 1, "latency_ms": 5.0, "time": 2.0 + i}
+             for i in range(3)]
+    if leak_terminal:
+        load.append({"event": "load", "action": "outcome", "id": 1,
+                     "status": "ok", "attempts": 2, "retried": True,
+                     "latency_ms": 9.0, "time": 9.0})
+    _write_jsonl(trial / "loadgen.jsonl", load)
+    serve = [{"event": "serve", "action": "admit", "id": i,
+              "deadline_ms": 1000.0, "time": 10.0 + i}
+             for i in range(3)]
+    serve += [{"event": "serve", "action": "respond", "id": i,
+               "model_step": 10, "tier": "fp32", "batch": 1,
+               "bucket": 1, "latency_ms": 5.0, "time": 20.0 + i}
+              for i in range(3)]
+    # the replay of id 1 after its respond: honest dedup
+    serve.append({"event": "serve", "action": "dedup_hit", "id": 1,
+                  "status": "ok", "age_s": 0.2, "time": 30.0})
+    if dishonest_dedup:
+        # a hit for an id this replica never completed
+        serve.append({"event": "serve", "action": "dedup_hit", "id": 9,
+                      "status": "ok", "age_s": 0.1, "time": 31.0})
+    _write_jsonl(trial / "worker1" / "serve_log.jsonl", serve)
+    if double_exec:
+        # id 5 admitted+executed on TWO replicas that were never
+        # net-faulted and that nobody retried against — a duplicate
+        # involving the faulted worker 1 would be licensed, this isn't
+        for k in (2, 3):
+            _write_jsonl(trial / f"worker{k}" / "serve_log.jsonl", [
+                {"event": "serve", "action": "admit", "id": 5,
+                 "deadline_ms": 1000.0, "time": 12.0 + k},
+                {"event": "serve", "action": "respond", "id": 5,
+                 "model_step": 10, "tier": "fp32", "batch": 1,
+                 "bucket": 1, "latency_ms": 5.0, "time": 22.0 + k}])
+    _write_jsonl(trial / "command_journal.jsonl", journal)
+    return trial, journal
+
+
+def test_invariant13_clean_retry_with_dedup_passes(tmp_path):
+    from distributedmnist_tpu.obsv.invariants import check_net_faults
+    trial, journal = _net_trial(tmp_path)
+    violations, applicable = check_net_faults(trial, {}, journal)
+    assert applicable and violations == []
+
+
+def test_invariant13_duplicate_terminal_fails(tmp_path):
+    from distributedmnist_tpu.obsv.invariants import check_net_faults
+    trial, journal = _net_trial(tmp_path, leak_terminal=True)
+    violations, applicable = check_net_faults(trial, {}, journal)
+    assert applicable
+    assert any("duplicate terminal" in v.detail for v in violations)
+
+
+def test_invariant13_dishonest_dedup_hit_fails(tmp_path):
+    from distributedmnist_tpu.obsv.invariants import check_net_faults
+    trial, journal = _net_trial(tmp_path, dishonest_dedup=True)
+    violations, applicable = check_net_faults(trial, {}, journal)
+    assert applicable
+    assert any("never computed" in v.detail for v in violations)
+
+
+def test_invariant13_unlicensed_double_execution_fails(tmp_path):
+    from distributedmnist_tpu.obsv.invariants import check_net_faults
+    trial, journal = _net_trial(tmp_path, double_exec=True)
+    violations, applicable = check_net_faults(trial, {}, journal)
+    assert applicable
+    assert any("unlicensed double execution" in v.detail
+               for v in violations)
+
+
+def test_invariant13_not_applicable_without_net_evidence(tmp_path):
+    from distributedmnist_tpu.obsv.invariants import (INVARIANTS,
+                                                      check_net_faults)
+    assert "net_faults" in INVARIANTS
+    (tmp_path / "t").mkdir()
+    violations, applicable = check_net_faults(tmp_path / "t", {}, [])
+    assert not applicable and violations == []
+
+
+def test_summarize_net_chaos_aggregates_and_absents(tmp_path):
+    from distributedmnist_tpu.obsv.journal import summarize_net_chaos
+    trial, _ = _net_trial(tmp_path)
+    got = summarize_net_chaos(trial)
+    assert got is not None
+    assert got["faults"] == {"net_reset": 1} and got["fired"] == 1
+    assert got["dedup_hits"] == 1 and got["retried"] == 1
+    assert got["retry_rate"] == round(1 / 3, 4)
+    assert got["attempts"]["max"] == 2.0
+    # a non-network trial carries no net slot at all
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert summarize_net_chaos(empty) is None

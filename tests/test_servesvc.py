@@ -294,6 +294,129 @@ def test_client_fails_over_and_deadline(published, tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# protocol hardening (ISSUE 19): dedup cache, deadlines, quarantine
+# ---------------------------------------------------------------------------
+
+def test_dedup_replay_answers_from_cache(published, tmp_path):
+    """A replayed request id (the retry after a reset ate the
+    response) is answered from the idempotency cache — journaled as a
+    ``dedup_hit`` AFTER the one respond, never a second execution."""
+    rep, _ = make_replica(published, tmp_path)
+    rep.start()
+    try:
+        make_input = sample_input(published)
+        payload = {"id": "r-7", "inputs": make_input(7)}
+        first = raw_request(rep.bound_port, payload)
+        replay = raw_request(rep.bound_port, payload)
+        assert first["status"] == "ok"
+        # byte-identical outcome: same step, same probs, same id
+        assert replay == first
+        assert rep.dedup_hits == 1
+        recs = serve_records(rep)
+        acts = [(r["action"], r.get("id")) for r in recs
+                if r.get("id") == "r-7"]
+        assert acts.count(("respond", "r-7")) == 1
+        assert acts.count(("admit", "r-7")) == 1
+        i_resp = acts.index(("respond", "r-7"))
+        assert ("dedup_hit", "r-7") in acts[i_resp:]
+    finally:
+        rep.stop()
+
+
+def test_dedup_cache_bound_evicts_oldest(published, tmp_path):
+    """The cache is bounded LRU: past ``dedup_cache_size`` distinct
+    ids, the oldest entry is gone and its replay re-executes (a second
+    admit+respond, not a hit) — memory stays bounded under churn."""
+    rep, _ = make_replica(published, tmp_path, dedup_cache_size=2)
+    rep.start()
+    try:
+        make_input = sample_input(published)
+        for i in range(3):  # id 0 evicted when id 2 lands
+            raw_request(rep.bound_port,
+                        {"id": i, "inputs": make_input(i)})
+        out = raw_request(rep.bound_port,
+                          {"id": 0, "inputs": make_input(0)})
+        assert out["status"] == "ok"
+        assert rep.dedup_hits == 0
+        recs = serve_records(rep)
+        assert sum(1 for r in recs if r.get("action") == "respond"
+                   and r.get("id") == 0) == 2
+    finally:
+        rep.stop()
+
+
+def test_slowloris_aborted_while_siblings_served(published, tmp_path):
+    """A peer trickling a half request (and one sending nothing: the
+    half-open case) costs ONE bounded stall of conn_read_timeout_s on
+    its own connection thread — journaled ``conn_abort``, no terminal
+    owed, and concurrent well-formed requests keep flowing."""
+    rep, _ = make_replica(published, tmp_path, conn_read_timeout_s=0.5)
+    rep.start()
+    try:
+        make_input = sample_input(published)
+        slow = socket.create_connection(("127.0.0.1", rep.bound_port),
+                                        timeout=10.0)
+        slow.sendall(b'{"id": 99, "inp')   # never finishes the line
+        half_open = socket.create_connection(
+            ("127.0.0.1", rep.bound_port), timeout=10.0)
+        # while both stalls are pending, the replica still serves
+        out = raw_request(rep.bound_port,
+                          {"id": 1, "inputs": make_input(1)})
+        assert out["status"] == "ok"
+        deadline = time.time() + 10.0
+        reasons: set = set()
+        while len(reasons) < 2 and time.time() < deadline:
+            reasons = {r.get("reason") for r in serve_records(rep)
+                       if r.get("action") == "conn_abort"}
+            time.sleep(0.05)
+        assert reasons == {"read_deadline", "half_open"}
+        # the aborted sockets are really closed, not leaked
+        slow.settimeout(2.0)
+        assert slow.recv(4096) == b""
+        slow.close()
+        half_open.close()
+        # no terminal was owed: admit/terminal books still balance
+        recs = serve_records(rep)
+        admits = sum(1 for r in recs if r.get("action") == "admit")
+        responds = sum(1 for r in recs if r.get("action") == "respond")
+        assert admits == responds
+    finally:
+        rep.stop()
+
+
+def test_client_quarantines_dead_endpoint(published, tmp_path):
+    """After a failed attempt the client benches that endpoint with
+    seeded jittered backoff — follow-up requests go straight to the
+    live sibling (attempts == 1) instead of re-dialing the corpse —
+    and the outcome records carry the attempt books."""
+    from distributedmnist_tpu.servesvc.client import ServeClient
+    rep, _ = make_replica(published, tmp_path)
+    rep.start()
+    try:
+        make_input = sample_input(published)
+        dead = socket.socket()
+        dead.bind(("127.0.0.1", 0))
+        dead_port = dead.getsockname()[1]
+        dead.close()
+        client = ServeClient([("127.0.0.1", dead_port),
+                              ("127.0.0.1", rep.bound_port)],
+                             deadline_s=10.0, max_attempts=4,
+                             quarantine_s=30.0, seed=3)
+        out = client.request(make_input(0), request_id=0)
+        assert out["status"] == "ok"
+        if out["attempts"] > 1:     # the dead endpoint was tried first
+            assert out["retried"] is True
+        assert client.quarantined() == [("127.0.0.1", dead_port)]
+        # benched: the next requests never pay the dead dial again
+        for i in range(1, 4):
+            out = client.request(make_input(i), request_id=i)
+            assert out["status"] == "ok" and out["attempts"] == 1
+            assert out["retried"] is False
+    finally:
+        rep.stop()
+
+
+# ---------------------------------------------------------------------------
 # quantized precision tiers (serve.precision_tier + the quant sidecar)
 # ---------------------------------------------------------------------------
 
@@ -654,3 +777,41 @@ def test_serving_chaos_trial_end_to_end(tmp_path):
     sv = summary["serving"]
     assert sv["issued"] > 0 and sv["dropped"] == 0, sv
     assert summary["faults"]["fired"] >= 1, summary["faults"]
+
+
+@pytest.mark.slow  # boots a publisher + 2 decode replicas + proxies (~4 min)
+def test_network_chaos_trial_end_to_end(tmp_path):
+    """ISSUE 19 acceptance: transport faults (chaos proxies) under
+    live decode load — every scheduled net fault fires, the mandatory
+    reset cuts a token stream MID-generation, the partition opens under
+    live traffic, zero requests are dropped, and invariant 13 holds
+    the exactly-once books."""
+    import json as _json
+    from distributedmnist_tpu.launch.chaos import ChaosConfig, run_campaign
+    cfg = ChaosConfig(name="nettrial", workdir=str(tmp_path),
+                      payload="serving", trials=1, seed=0,
+                      until_step=60, save_interval_steps=10,
+                      serve_replicas=2, serve_decode=True, network=True,
+                      shrink=False, trial_timeout_s=420.0)
+    summary = run_campaign(cfg)
+    assert summary["trials"] == 1
+    assert summary["all_green"], summary
+    inv = summary["invariants"]
+    assert inv["net_faults"]["pass"] == 1, inv
+    for name in ("serve_outcomes", "serve_digest", "serve_monotone",
+                 "decode_swap"):
+        assert inv[name]["pass"] == 1, (name, inv)
+    sv = summary["serving"]
+    assert sv["issued"] > 0 and sv["dropped"] == 0, sv
+    assert summary["faults"]["never_fired"] == 0, summary["faults"]
+    net = summary["net"]
+    assert net["fired"] >= 2, net
+    assert net["faults_by_kind"].get("net_reset") == 1, net
+    assert net["faults_by_kind"].get("net_partition") == 1, net
+    # the reset's journal record proves the cut was MID-stream (bytes
+    # had already flowed) and the partition cut LIVE connections
+    recs = [_json.loads(l) for l in
+            (tmp_path / "nettrial" / "trial000"
+             / "command_journal.jsonl").read_text().splitlines()]
+    rst = [r for r in recs if r.get("action") == "net_reset"]
+    assert rst and rst[0]["mid_stream"] and rst[0]["bytes_passed"] > 0

@@ -99,6 +99,91 @@ def test_group_restart_budget_exhausted(tmp_path):
 
 
 @pytest.mark.tier1
+def test_group_restart_on_rank0_socket_reset_via_proxy(tmp_path):
+    """ISSUE 19 crossover: a rank whose WIRE dies (chaos-proxy RST
+    mid-stream, not a signal) exits like any other crash — the
+    supervisor must still journal the full die-as-a-unit chain
+    ``rank_exit`` → ``group_down`` → ``group_restart``."""
+    import socket
+    import threading
+
+    from distributedmnist_tpu.launch.netchaos import ChaosProxy
+    from distributedmnist_tpu.servesvc.tp_group import ServeGroup
+
+    # upstream: a tiny streamer the proxied rank reads from — accepts
+    # serially (attempt 0's rank 0, then attempt 1's) and drips bytes
+    # so the proxy's downstream pump crosses the reset threshold
+    lsock = socket.create_server(("127.0.0.1", 0))
+    lsock.settimeout(0.2)
+    up_port = lsock.getsockname()[1]
+    stop = threading.Event()
+
+    def streamer():
+        while not stop.is_set():
+            try:
+                conn, _ = lsock.accept()
+            except TimeoutError:
+                continue
+            with conn:
+                try:
+                    while not stop.is_set():
+                        conn.sendall(b"x" * 16)
+                        time.sleep(0.01)
+                except OSError:
+                    pass
+
+    t = threading.Thread(target=streamer, daemon=True)
+    t.start()
+
+    proxy = ChaosProxy(("127.0.0.1", up_port),
+                       [{"kind": "reset", "after_bytes": 64}], worker=0)
+    proxy_port = proxy.start()
+
+    # rank 0 is a real socket reader through the proxy: it exits(1)
+    # the moment its connection dies; rank 1 is the inert stub
+    reader = ("import socket, sys\n"
+              f"s = socket.create_connection(('127.0.0.1', {proxy_port}),"
+              " timeout=10)\n"
+              "s.settimeout(10)\n"
+              "try:\n"
+              "    while True:\n"
+              "        if not s.recv(4096):\n"
+              "            sys.exit(1)\n"
+              "except OSError:\n"
+              "    sys.exit(1)\n")
+
+    def spawn(rank, attempt):
+        if rank == 0:
+            return subprocess.Popen([sys.executable, "-c", reader])
+        return _stub_spawn(rank, attempt)
+
+    g = ServeGroup(tmp_path / "g", 2, spawn, max_restarts=2,
+                   poll_secs=0.01)
+    try:
+        g.start()
+        # the one-shot reset fires after ~4 drip chunks; poll until
+        # the supervisor has seen the exit and restarted the unit
+        deadline = time.time() + 10.0
+        while g.attempt == 0 and time.time() < deadline:
+            g.step()
+            time.sleep(0.02)
+        assert g.attempt == 1, "proxy reset never took rank 0 down"
+        assert all(p.poll() is None for p in g.procs.values())
+        acts = _actions(_group_records(tmp_path / "g"))
+        i_exit = acts.index("rank_exit")
+        assert acts[i_exit:i_exit + 2] == ["rank_exit", "group_down"]
+        assert "group_restart" in acts[i_exit:]
+        recs = _group_records(tmp_path / "g")
+        assert recs[i_exit]["rank"] == 0
+    finally:
+        g.stop()
+        proxy.stop()
+        stop.set()
+        t.join(timeout=5)
+        lsock.close()
+
+
+@pytest.mark.tier1
 def test_default_spawn_fn_rewrites_rank_argv(tmp_path, monkeypatch):
     """The supervisor re-invokes the SAME serve command per rank, with
     only serve-dir/rank identity rewritten (and any stale --tp-rank*
