@@ -959,6 +959,104 @@ def bench_save_stall() -> dict:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_checkpoint_durability() -> dict:
+    """Storage-shim fsync tax (ISSUE 20): the full atomic-save
+    protocol (tmp write → rename → digest sidecar → pointer) under
+    ``train.durability=full`` (fsync data + sidecars + directory
+    entries) vs ``none`` (the default: rename-atomic, no flush),
+    identical state bytes, medians over INTERLEAVED repeats — one
+    none/full rotation per repeat, so page-cache and disk drift land
+    on both policies alike (the r05 cdf lesson).
+
+    Gate, backend-dependent (the weak_scaling precedent — the claim
+    is about OUR save machinery, not the runner's disk):
+
+      * accelerators — full ≤ 3× none median: production NVMe fsyncs
+        are sub-ms, so a larger multiple means the shim is flushing
+        per-write instead of per-artifact.
+      * CPU runners (CI) — full ≤ 10× none + 100 ms absolute: shared
+        CI disks put 1-50 ms on every fsync and the none-arm median
+        is small enough that the ratio alone is noise; the absolute
+        term keeps a save well under any cadence budget while still
+        catching per-byte-flush pathologies.
+
+    Crash-consistency itself is not gated here — that is
+    tests/test_crash_consistency.py's job; this case prices the knob
+    so the README's policy table carries a measured number."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    from distributedmnist_tpu.train import checkpoint as ckpt
+    from distributedmnist_tpu.train import storage
+
+    rng = np.random.default_rng(0)
+    # flagship-CNN-sized state: ~7 MB of params + momentum
+    state = {"params": {f"layer{i}": rng.standard_normal(
+                 (256, 256)).astype(np.float32) for i in range(12)},
+             "momentum": {f"layer{i}": rng.standard_normal(
+                 (256, 256)).astype(np.float32) for i in range(12)},
+             "step": np.int32(0)}
+    state_bytes = sum(a.nbytes for a in
+                      [*state["params"].values(),
+                       *state["momentum"].values()])
+    workdir = Path(tempfile.mkdtemp(prefix="dmt_durability_"))
+    n_repeats, saves_per_repeat = 5, 3
+    wall_ms: dict[str, list[float]] = {"none": [], "full": []}
+    try:
+        step = 0
+        for _ in range(n_repeats):  # interleaved: one rotation each
+            for policy in ("none", "full"):
+                d = workdir / policy
+                d.mkdir(exist_ok=True)
+                storage.set_durability(policy)
+                for _ in range(saves_per_repeat):
+                    step += 1
+                    t0 = time.perf_counter()
+                    ckpt.save_checkpoint(d, state, step)
+                    wall_ms[policy].append(
+                        (time.perf_counter() - t0) * 1e3)
+    finally:
+        storage.set_durability("none")
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    med = {k: statistics.median(v) for k, v in wall_ms.items()}
+    ratio = med["full"] / med["none"]
+    extra_ms = med["full"] - med["none"]
+    cpu = jax.default_backend() == "cpu"
+    if cpu:
+        passes = med["full"] <= med["none"] * 10.0 + 100.0
+        gate = ("cpu runner: durability=full median save wall ≤ 10× "
+                "none + 100 ms (shared CI disks make the bare ratio "
+                "noise; the absolute term still catches per-byte "
+                "flushing)")
+    else:
+        passes = ratio <= 3.0
+        gate = ("accelerator host: durability=full median save wall "
+                "≤ 3× none (NVMe fsyncs are sub-ms — a larger "
+                "multiple means the shim flushes per-write, not "
+                "per-artifact)")
+    return {
+        "metric": "checkpoint_durability_overhead",
+        "value": round(ratio, 3),
+        "unit": "x (durability=full/none median save wall)",
+        "passes_gate": bool(passes),
+        "detail": {
+            "gate": (f"{gate}; medians over {n_repeats} interleaved "
+                     f"repeats × {saves_per_repeat} saves"),
+            "state_bytes": state_bytes,
+            "save_wall_ms_median": {k: round(v, 3)
+                                    for k, v in med.items()},
+            "fsync_extra_ms_median": round(extra_ms, 3),
+            "save_wall_ms_all": {k: [round(x, 2) for x in v]
+                                 for k, v in wall_ms.items()},
+            "fsync_scope": {"none": "rename-atomic only",
+                            "full": "data + sidecar + pointer + "
+                                    "directory entries"},
+            **_env_stamp()},
+    }
+
+
 def bench_weak_scaling() -> dict:
     """Weak-scaling efficiency of the large-batch playbook (ROADMAP
     item 4, arXiv:1909.09756): images/sec at 1→2→4→8 devices with a
@@ -2914,6 +3012,7 @@ def main() -> None:
                  bench_mode_overhead, bench_native_loader,
                  bench_input_pipeline_overlap, bench_weight_update_sharding,
                  bench_zero1_overlap, bench_save_stall,
+                 bench_checkpoint_durability,
                  bench_weak_scaling, bench_restart_latency,
                  bench_serving_latency, bench_degraded_network,
                  bench_quantized_serving,

@@ -56,8 +56,9 @@ def main(argv: list[str] | None = None) -> int:
     # path), not after parsing the whole tree
     names = (set(args.checkers.split(",")) if args.checkers else None)
     if names is not None:
-        from . import (config_check, jax_check,  # noqa: F401
-                       paged_check, schema_check, threads_check)
+        from . import (config_check, durability_check,  # noqa: F401
+                       jax_check, net_check, paged_check,
+                       schema_check, threads_check)
         unknown = names - set(CHECKERS)
         if unknown:
             ap.error(f"unknown checker(s): "

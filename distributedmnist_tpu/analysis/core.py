@@ -146,8 +146,8 @@ def register(name: str):
 def run_checkers(sources: list[Source],
                  names: Iterable[str] | None = None) -> list[Finding]:
     # import for side effect: each checker module registers itself
-    from . import (config_check, jax_check, net_check,  # noqa: F401
-                   paged_check, schema_check, threads_check)
+    from . import (config_check, durability_check, jax_check,  # noqa: F401
+                   net_check, paged_check, schema_check, threads_check)
     findings: list[Finding] = []
     # an unparseable file yields an empty AST — every checker would
     # silently report it clean (and its dropped reads could even fake

@@ -588,6 +588,15 @@ class TrainConfig:
     # faulted mid-traffic — numerics are untouched, only the publish
     # cadence stretches.
     step_pace_ms: float = 0.0
+    # Durability policy for durable artifacts, routed through the
+    # storage shim (train/storage.py): "none" keeps the historical
+    # buffered writes (rename-only atomicity), "data" fsyncs
+    # checkpoint/manifest payload bytes before the publishing rename,
+    # "full" additionally fsyncs digest sidecars, the pointer, JSONL
+    # journal appends, and the parent dir after renames (the
+    # power-cut-proof bound the checkpoint_durability bench prices).
+    # Unknown values raise a typed ConfigError at trainer init.
+    durability: str = "none"
     # Preemption handling: SIGTERM/SIGINT flush the AsyncCheckpointer
     # and stop the loop cleanly; the CLI then exits with
     # resumable_exit_code (default 75 = EX_TEMPFAIL) so a supervisor

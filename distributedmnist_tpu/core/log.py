@@ -17,6 +17,13 @@ import time
 from pathlib import Path
 from typing import Any, IO
 
+# The storage shim (train/storage.py) owns the fsync policy for
+# journal appends (train.durability=full). Looked up through
+# sys.modules instead of imported: a process that never loaded the
+# trainer can never have set a non-default policy, and this module
+# must stay importable without jax (the train package pulls it in).
+_STORAGE_MODULE = __package__.rsplit(".", 1)[0] + ".train.storage"
+
 # Sampled once, on the first write: the gate is a test-harness/debug
 # switch, not a runtime toggle, and the write path is hot (per-step
 # records). The parse itself lives in ONE place —
@@ -60,6 +67,9 @@ class JsonlSink:
             from ..obsv.schema import check_event
             check_event(record, source=self.path.name)
         self._fh.write(json.dumps(record, default=_default) + "\n")
+        storage = sys.modules.get(_STORAGE_MODULE)
+        if storage is not None and storage.journal_sync_enabled():
+            storage.fsync_journal(self._fh)
 
     def close(self) -> None:
         self._fh.close()

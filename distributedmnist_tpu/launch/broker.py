@@ -54,6 +54,7 @@ from pathlib import Path
 from typing import Any
 
 from ..core.config import BrokerConfig
+from ..obsv.journal import tail_records
 from ..servesvc.loadgen import read_latest_window
 
 logger = logging.getLogger(__name__)
@@ -86,25 +87,11 @@ def tail_heartbeat(logdir: str | Path,
     ``train_log.jsonl`` — the per-replica pressure channel (queue
     occupancy, KV block-pool fill) the broker polls every tick. Reads
     only the file tail and scans backwards past torn lines, same
-    discipline as :func:`~..servesvc.loadgen.read_latest_window`."""
-    path = Path(logdir) / "train_log.jsonl"
-    try:
-        with open(path, "rb") as f:
-            f.seek(0, 2)
-            size = f.tell()
-            f.seek(max(0, size - tail_bytes))
-            data = f.read().decode("utf-8", errors="replace")
-    except OSError:
-        return None
-    for line in reversed(data.splitlines()):
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            rec = json.loads(line)
-        except json.JSONDecodeError:
-            continue
-        if isinstance(rec, dict) and rec.get("event") == "heartbeat":
+    discipline (obsv/journal.py ``tail_records``) as
+    :func:`~..servesvc.loadgen.read_latest_window`."""
+    for rec in tail_records(Path(logdir) / "train_log.jsonl",
+                            tail_bytes=tail_bytes):
+        if rec.get("event") == "heartbeat":
             return rec
     return None
 

@@ -57,7 +57,19 @@ logger = get_logger("chaos")
 
 FAULT_KINDS = ("kill", "hang", "stall", "corrupt", "delay", "resize",
                "net_latency", "net_bandwidth", "net_reset",
-               "net_blackhole", "net_partition")
+               "net_blackhole", "net_partition",
+               "disk_enospc_after_bytes", "disk_eio", "disk_slow_io_ms",
+               "disk_torn_write_at_byte", "disk_crash_rename")
+
+# schedule kind → the action name the worker's DiskFaultInjector
+# journals when it fires (train/storage.py) — the fired-fault
+# accounting and the storage_faults invariant both read firings from
+# the per-worker storage_faults.jsonl under these names
+DISK_FAULT_ACTIONS = {"disk_enospc_after_bytes": "disk_enospc",
+                      "disk_eio": "disk_eio",
+                      "disk_slow_io_ms": "disk_slow_io",
+                      "disk_torn_write_at_byte": "disk_torn_write",
+                      "disk_crash_rename": "disk_crash_rename"}
 
 # The cheap non-jax payload (the supervisor tests' resuming shell loop):
 # ~20 steps/s, a file "checkpoint" every 5 steps so restarts observably
@@ -147,7 +159,11 @@ class ChaosFault:
     key/value pairs (kind=net_* only — a tuple, not a dict, so the
     frozen dataclass stays hashable; ``worker`` is the PROXIED
     replica and ``step`` is unused: transport faults trigger on
-    traffic/wall-time, not train steps)."""
+    traffic/wall-time, not train steps). ``disk`` carries a storage
+    fault's script parameters the same way (kind=disk_* only;
+    ``step`` is the earliest train step the script may fire at — the
+    worker's injector arms it against the next durable save at or
+    after that step)."""
 
     kind: str
     worker: int = 0
@@ -156,6 +172,7 @@ class ChaosFault:
     verb: str = ""
     world: int = 0
     net: tuple[tuple[str, float], ...] = ()
+    disk: tuple[tuple[str, Any], ...] = ()
 
     def to_dict(self) -> dict[str, Any]:
         d: dict[str, Any] = {"kind": self.kind}
@@ -165,6 +182,9 @@ class ChaosFault:
             d.update(step=self.step, world=self.world)
         elif self.kind.startswith("net_"):
             d.update(worker=self.worker, **dict(self.net))
+        elif self.kind.startswith("disk_"):
+            d.update(worker=self.worker, step=self.step,
+                     **dict(self.disk))
         else:
             d.update(worker=self.worker, step=self.step)
             if self.kind == "stall":
@@ -188,6 +208,7 @@ class ChaosSchedule:
         delay: dict[str, float] = {}
         resize: tuple[int, int] | None = None
         net: dict[int, list[dict]] = {}
+        disk: dict[int, list[dict]] = {}
         for f in self.faults:
             if f.kind == "kill":
                 kill[f.worker] = f.step
@@ -206,6 +227,13 @@ class ChaosSchedule:
                 # grammar is launch/netchaos.py's (kind sans prefix)
                 net.setdefault(f.worker, []).append(
                     {"kind": f.kind[len("net_"):], **dict(f.net)})
+            elif f.kind.startswith("disk_"):
+                # per-worker storage scripts; the grammar is
+                # train/storage.py's (kind sans prefix, at_step from
+                # the fault's step axis)
+                disk.setdefault(f.worker, []).append(
+                    {"kind": f.kind[len("disk_"):], "at_step": f.step,
+                     **dict(f.disk)})
             else:
                 raise ClusterError(f"unknown chaos fault kind {f.kind!r}")
         return FaultPlan(kill_worker_at_step=kill,
@@ -214,7 +242,8 @@ class ChaosSchedule:
                          corrupt_latest_checkpoint_at_step=corrupt,
                          delay_ms=delay,
                          resize_world_at_step=resize,
-                         net_faults=net)
+                         net_faults=net,
+                         disk_faults=disk)
 
     def to_json_dict(self) -> dict[str, Any]:
         return {"seed": self.seed, "trial": self.trial,
@@ -229,6 +258,9 @@ class ChaosSchedule:
              else f"{f.kind}(w{f.worker}: "
                   + ", ".join(f"{k}={v:g}" for k, v in f.net) + ")"
              if f.kind.startswith("net_")
+             else f"{f.kind}(w{f.worker}@{f.step}: "
+                  + ", ".join(f"{k}={v}" for k, v in f.disk) + ")"
+             if f.kind.startswith("disk_")
              else f"{f.kind}(w{f.worker}@{f.step}"
                   + (f", {f.ms:.0f}ms)" if f.kind == "stall" else ")"))
             for f in self.faults)
@@ -441,6 +473,110 @@ def generate_network_schedule(seed: int, trial: int,
     return ChaosSchedule(seed=seed, trial=trial, faults=tuple(faults))
 
 
+def generate_disk_schedule(seed: int, trial: int, num_workers: int,
+                           step_window: tuple[int, int],
+                           save_interval_steps: int,
+                           max_faults: int = 4, min_faults: int = 3,
+                           io_attempts: int | None = None
+                           ) -> ChaosSchedule:
+    """Disk-mode schedules (deterministic in (seed, trial)); its own
+    generator — and its own rng stream (K=4_000_003, disjoint from the
+    training, serving and network arms') — because the fault GRAMMAR
+    differs:
+
+    * ALWAYS one ``disk_enospc_after_bytes`` against a worker's
+      checkpoint writes, with ``times`` = the writer's retry budget so
+      every attempt of ONE cadence save hits a full disk: the save
+      must fail all the way through, the worker must journal
+      ``save_failed`` and keep training — the graceful-degradation
+      path the storage shim exists for.
+    * ALWAYS one ``disk_torn_write_at_byte``: a write that lands only
+      a prefix. One firing is absorbed by the retry loop (journaled,
+      save still lands); the retry-budget variant turns it into a
+      second failed cadence — both are drawn.
+    * ALWAYS one ``disk_crash_rename`` (the power-cut model: rename
+      applied, data lost) aligned to a SAVE step, paired with a kill
+      just after it — silent corruption is only observable when a
+      restarted worker's restore walks the pointer into the corrupt
+      artifact and falls back, so the pair rides together the way the
+      training arm pairs corrupt+kill. ``times=2`` covers the race
+      where the kill lands after one more cadence save: the next
+      artifact is corrupted too, and the fallback walk is exercised
+      regardless of poll latency. The ENOSPC script is kept off this
+      worker so a skipped save cannot swallow the rename the crash
+      needs.
+    * Extra write-path ``disk_eio`` / ``disk_slow_io_ms`` scripts up
+      to ``max_faults`` intensity units, at most one of each kind per
+      worker.
+
+    Disk triggers are on the TRAIN-STEP axis (``at_step`` arms the
+    script against the next durable save at or after that step), so
+    the step window is the training one."""
+    import random
+    if io_attempts is None:
+        # the writer's retry budget IS the "exhaust every attempt"
+        # threshold — read it from the one place it's defined so the
+        # generator can't drift from the checkpoint writer
+        from ..train.checkpoint import _IO_ATTEMPTS as io_attempts
+    rng = random.Random(seed * 4_000_003 + trial)
+    lo, hi = step_window
+    hi = max(hi, lo)
+    w_crash = rng.randrange(num_workers)
+    w_enospc = rng.randrange(num_workers)
+    if num_workers > 1 and w_enospc == w_crash:
+        w_enospc = (w_crash + 1) % num_workers
+    # align the crash_rename with an actual save cadence step so the
+    # paired kill can land between the corrupted save and the next one
+    save_steps = [s for s in range(lo, hi + 1)
+                  if s % max(1, save_interval_steps) == 0] or [lo]
+    crash_step = rng.choice(save_steps)
+    torn_times = rng.choice((1, io_attempts))
+    faults: list[ChaosFault] = [
+        ChaosFault(kind="disk_enospc_after_bytes", worker=w_enospc,
+                   step=rng.randint(lo, hi),
+                   disk=(("bytes", rng.randint(0, 512)),
+                         ("match", ".msgpack"),
+                         ("times", io_attempts))),
+        ChaosFault(kind="disk_torn_write_at_byte",
+                   worker=rng.randrange(num_workers),
+                   step=rng.randint(lo, hi),
+                   disk=(("at_byte", rng.randint(64, 4096)),
+                         ("match", ".msgpack"),
+                         ("times", torn_times))),
+        ChaosFault(kind="disk_crash_rename", worker=w_crash,
+                   step=crash_step,
+                   disk=(("match", ".msgpack"), ("times", 2))),
+        ChaosFault(kind="kill", worker=w_crash, step=crash_step + 1),
+    ]
+    used = {(f.kind, f.worker) for f in faults}
+    n = rng.randint(min_faults, max(min_faults, max_faults))
+    combos = [(kind, w) for kind in ("disk_eio", "disk_slow_io_ms")
+              for w in range(num_workers)]
+    rng.shuffle(combos)
+    units = 3  # the mandatory trio; the paired kill rides free
+    for kind, w in combos:
+        if units >= n:
+            break
+        if (kind, w) in used:
+            continue
+        used.add((kind, w))
+        step = rng.randint(lo, hi)
+        if kind == "disk_eio":
+            # write-path EIO, one firing: absorbed by the retry loop
+            # (journaled; the save still lands) — read-path EIO only
+            # fires on a restore, which an unfaulted worker never runs
+            disk = (("match", ".msgpack"), ("nth", 1), ("op", "write"),
+                    ("times", 1))
+        else:
+            disk = (("match", ".msgpack"),
+                    ("ms", round(rng.uniform(5.0, 40.0), 1)),
+                    ("times", 2))
+        faults.append(ChaosFault(kind=kind, worker=w, step=step,
+                                 disk=disk))
+        units += 1
+    return ChaosSchedule(seed=seed, trial=trial, faults=tuple(faults))
+
+
 def count_fired_faults(trial_dir: Path,
                        schedule: ChaosSchedule) -> dict[str, Any]:
     """Scheduled-vs-actually-fired accounting for one trial, from the
@@ -451,14 +587,23 @@ def count_fired_faults(trial_dir: Path,
     journals its firing: worker faults as ``event: "fault"`` records,
     exec delays as ``injected_delay_ms`` on command records, the
     resize fault as the supervisor's ``event: "reconfigure"`` begin
-    with ``trigger: "fault_plan"``."""
+    with ``trigger: "fault_plan"``, and disk faults as the WORKER
+    process's own ``event: "fault"`` records in its
+    ``storage_faults.jsonl`` (the injector lives inside the worker's
+    durable-write path, not the supervisor)."""
     from ..obsv.report import load_jsonl
     records = load_jsonl(trial_dir / "command_journal.jsonl")
     fault_actions = {"kill": "kill_worker", "hang": "hang_worker",
                      "stall": "stall_worker",
                      "corrupt": "corrupt_latest_checkpoint"}
+    fault_actions.update(DISK_FAULT_ACTIONS)
     fired_kw = {(r.get("action"), r.get("worker"))
                 for r in records if r.get("event") == "fault"}
+    if any(f.kind.startswith("disk_") for f in schedule.faults):
+        for d in sorted(trial_dir.glob("worker*")):
+            for r in load_jsonl(d / "storage_faults.jsonl"):
+                if r.get("event") == "fault":
+                    fired_kw.add((r.get("action"), r.get("worker")))
     delay_fired = any(r.get("event") == "command"
                       and r.get("injected_delay_ms")
                       for r in records)
@@ -563,6 +708,19 @@ class ChaosConfig:
     # mandatory reset must cut a token STREAM mid-generation, and
     # only the decode wire protocol streams.
     network: bool = False
+    # disk=true swaps the training arm's process-fault grammar for the
+    # STORAGE one (generate_disk_schedule): every trial scripts
+    # deterministic disk faults (ENOSPC budgets, EIO, torn writes,
+    # power-cut renames, slow I/O) into the workers' own durable-write
+    # path (train/storage.py, armed via the fault plan's disk_faults →
+    # DMT_DISK_FAULTS), always including a retry-exhausting ENOSPC, a
+    # torn write, and a crash_rename paired with a kill — and the
+    # storage_faults invariant (14) replays alongside the training
+    # ones. Requires payload=train: the faults target real checkpoint
+    # saves, which the shell and serving payloads don't perform (the
+    # serving arm's published-artifact corruption is the existing
+    # ``corrupt`` fault).
+    disk: bool = False
     # -- resource broker (serving mode only) ------------------------------
     # broker=true arms demand-driven autoscaling (launch/broker.py)
     # over the trial's roster: DONOR train workers join it
@@ -681,6 +839,19 @@ class ChaosConfig:
                     "the broker's traded roster would outgrow the "
                     "boot-time proxy set, leaving new replicas "
                     "unproxied mid-trial")
+        if self.disk:
+            if self.payload != "train":
+                raise ClusterError(
+                    "disk=true requires payload=train: storage faults "
+                    "target the trainer's durable checkpoint writes, "
+                    "which the shell and serving payloads don't "
+                    "perform")
+            if self.save_interval_steps < 2:
+                raise ClusterError(
+                    "disk=true requires save_interval_steps >= 2: the "
+                    "crash_rename fault pairs with a kill one step "
+                    "after the save it corrupts, so at least one step "
+                    "must separate consecutive cadence saves")
         if self.broker:
             # the broker recognizes serving slots by command EQUALITY
             # with one uniform serving payload — a mixed-tier roster
@@ -1397,6 +1568,7 @@ class ChaosCampaign:
     # -- the campaign ---------------------------------------------------
 
     def run(self) -> dict[str, Any]:
+        from ..obsv.journal import summarize_disk_chaos
         cfg = self.cfg
         if cfg.root.exists():
             shutil.rmtree(cfg.root)  # stale trial state must not bleed in
@@ -1454,6 +1626,17 @@ class ChaosCampaign:
                     cfg.serve_fault_window, cfg.step_window(),
                     max_faults=cfg.max_faults, min_faults=cfg.min_faults,
                     stall_ms_range=cfg.resolved_stall_ms_range())
+            elif cfg.disk:
+                # storage faults only: the workers' own durable-write
+                # shims carry the whole chaos load, so the atomic-save
+                # protocol's claims are tested in isolation from
+                # supervisor-injected process faults (bar the one kill
+                # the crash_rename pairing needs)
+                schedule = generate_disk_schedule(
+                    cfg.seed, t, cfg.num_workers, cfg.step_window(),
+                    cfg.save_interval_steps,
+                    max_faults=cfg.max_faults,
+                    min_faults=max(3, cfg.min_faults))
             else:
                 schedule = generate_schedule(
                     cfg.seed, t, cfg.num_workers, cfg.step_window(),
@@ -1507,6 +1690,11 @@ class ChaosCampaign:
                    # network-mode evidence (net_* firings by kind,
                    # dedup hits, retry percentiles); None off-mode
                    "net": outcome.get("net"),
+                   # disk-mode evidence (storage-fault firings by
+                   # action, failed/skipped saves, fallback restores);
+                   # None off-mode
+                   "disk": (summarize_disk_chaos(cfg.root / rel)
+                            if cfg.disk else None),
                    "verdicts": check["verdicts"],
                    "violations": check["violations"]}
             if outcome.get("broker"):

@@ -38,6 +38,7 @@ from pathlib import Path
 from typing import Any
 
 from ..core.log import get_logger
+from ..obsv.journal import tail_records
 from .exec import CommandExecutor, ExecError, FaultPlan, RetryPolicy
 
 logger = get_logger("cluster")
@@ -50,24 +51,17 @@ class ClusterError(RuntimeError):
 def parse_poll_output(text: str | None) -> dict[str, Any]:
     """Parse the tail of a ``train_log.jsonl`` into {"step", "record"}.
 
-    Scans BACKWARDS past a torn/non-JSON final line to the last intact
-    STEP record: the writer may be mid-append when the tail runs, and
-    reporting step -1 for a whole poll tick makes live progress look
-    stalled — which a supervisor's ``stall_timeout_s`` could misread as
-    a hang. Intact non-step records (the ``event: "compile"`` line a
-    precompiling worker appends before its first step) are skipped the
-    same way: they are liveness, not regression to -1. step is -1 only
-    when no step record exists at all (run still booting, or the tail
-    window held nothing usable — the next poll resolves it).
+    Scans BACKWARDS (obsv/journal.py ``tail_records``) past a torn/
+    non-JSON final line to the last intact STEP record: reporting step
+    -1 for a whole poll tick makes live progress look stalled — which
+    a supervisor's ``stall_timeout_s`` could misread as a hang. Intact
+    non-step records (the ``event: "compile"`` line a precompiling
+    worker appends before its first step) are skipped the same way:
+    they are liveness, not regression to -1. step is -1 only when no
+    step record exists at all (run still booting, or the tail window
+    held nothing usable — the next poll resolves it).
     """
-    for line in reversed((text or "").strip().splitlines()):
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            record = json.loads(line)
-        except json.JSONDecodeError:
-            continue  # torn write — keep scanning backwards
+    for record in tail_records(text=text or ""):
         if "step" not in record:
             continue  # compile/other event record — not a step reading
         return {"step": int(record["step"]), "record": record}
@@ -568,6 +562,20 @@ class LocalProcessCluster(ClusterBackend):
         env.update({"DMT_WORKER_INDEX": str(k),
                     "DMT_NUM_WORKERS": str(self.cfg.num_workers),
                     "DMT_WORKER_DIR": str(self.cfg.worker_dir(k))})
+        # Disk-fault scripts arm INSIDE the worker's own durable-write
+        # path (train/storage.py reads this at first shim op); firings
+        # land in the worker's storage_faults.jsonl, which the chaos
+        # fired-fault count and the storage_faults invariant read.
+        # Per-incarnation-safe: a restarted worker re-arms the same
+        # deterministic scripts (counters reset with the process).
+        scripts = self.exec.fault_plan.disk_faults.get(k)
+        if scripts:
+            env["DMT_DISK_FAULTS"] = json.dumps({
+                "worker": k, "faults": scripts,
+                "journal": str(Path(self.cfg.worker_dir(k))
+                               / "storage_faults.jsonl")})
+        else:
+            env.pop("DMT_DISK_FAULTS", None)
         return env
 
     def _pid_alive(self, pid: int) -> bool:
@@ -1361,6 +1369,14 @@ def main(argv: list[str] | None = None) -> None:
                         "trial — instead of process faults; invariant "
                         "13 (net_faults) replays the exactly-once "
                         "books")
+    p.add_argument("--disk", action="store_true",
+                   help="for chaos (payload=train): storage faults via "
+                        "the workers' durable-write shim "
+                        "(train/storage.py) — retry-exhausting ENOSPC, "
+                        "torn write, and power-cut rename paired with "
+                        "a kill every trial — instead of process "
+                        "faults; invariant 14 (storage_faults) replays "
+                        "the crash-consistency books")
     p.add_argument("--serve-command", default=None,
                    help="for broker: the serving payload a scaled-up "
                         "replica slot runs — also how the broker "
@@ -1415,6 +1431,8 @@ def main(argv: list[str] | None = None) -> None:
             overrides["serve_decode"] = True
         if args.network:
             overrides["network"] = True
+        if args.disk:
+            overrides["disk"] = True
         # merged before construction — __post_init__ validates
         # cross-field constraints, so flags can't land via replace()
         ccfg = (ChaosConfig.from_file(args.chaos_config, overrides=overrides)

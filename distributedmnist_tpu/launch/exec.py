@@ -142,6 +142,21 @@ class FaultPlan:
                                          bandwidth, reset, blackhole,
                                          partition} plus kind-specific
                                          knobs (see ``ChaosProxy``)
+    ``disk_faults`` {k: [script, …]}   — storage faults inside worker
+                                         ``k``'s own durable-write path
+                                         (``train/storage.py``, armed
+                                         via the ``DMT_DISK_FAULTS``
+                                         env the backend threads into
+                                         the worker): each script is a
+                                         dict with a ``kind`` in
+                                         {enospc_after_bytes, eio,
+                                         slow_io_ms,
+                                         torn_write_at_byte,
+                                         crash_rename} plus
+                                         kind-specific knobs (see
+                                         ``DiskFaultInjector``);
+                                         firings land in the worker's
+                                         ``storage_faults.jsonl``
 
     Every action fires at most once per worker per run.
     """
@@ -161,6 +176,11 @@ class FaultPlan:
     resize_world_at_step: tuple[int, int] | None = None
     # {worker: [net fault scripts]} — consumed by netchaos.ChaosProxy
     net_faults: dict[int, list[dict]] = dataclasses.field(
+        default_factory=dict)
+    # {worker: [disk fault scripts]} — armed inside the worker process
+    # by train/storage.py (the backend serializes each worker's list
+    # into its DMT_DISK_FAULTS environment)
+    disk_faults: dict[int, list[dict]] = dataclasses.field(
         default_factory=dict)
 
     _WORKER_KEYED = ("kill_worker_at_step", "hang_worker_at_step",
@@ -190,6 +210,9 @@ class FaultPlan:
         if "net_faults" in d:
             d["net_faults"] = {int(k): [dict(s) for s in v]
                                for k, v in d["net_faults"].items()}
+        if "disk_faults" in d:
+            d["disk_faults"] = {int(k): [dict(s) for s in v]
+                                for k, v in d["disk_faults"].items()}
         return cls(**d)
 
     def to_json_dict(self) -> dict:

@@ -81,7 +81,7 @@ INVARIANTS = ("terminal_state", "metrics_log", "determinism",
               "causality", "checkpoint_integrity", "reconfigure",
               "serve_outcomes", "serve_digest", "serve_monotone",
               "decode_swap", "serve_group", "autoscale", "discipline",
-              "net_faults")
+              "net_faults", "storage_faults")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1099,6 +1099,176 @@ def check_net_faults(trial_dir: str | Path, outcome: dict,
 
 
 # ---------------------------------------------------------------------------
+# (14) storage-fault licensing + atomic-save protocol ordering
+# ---------------------------------------------------------------------------
+
+# injector actions that surface to the writer as an OSError — the only
+# firings that can license a skipped cadence save (train/storage.py)
+_DISK_ERROR_ACTIONS = ("disk_enospc", "disk_eio", "disk_torn_write")
+# injector actions that leave CORRUPT bytes behind (a torn prefix, a
+# power-cut rename) — the only firings that can license a restore
+# walking past a checkpoint, and the targets invariant (5) must exempt
+_DISK_CORRUPT_ACTIONS = ("disk_torn_write", "disk_crash_rename")
+
+
+def load_storage_faults(trial_dir: str | Path) -> dict[int, list[dict]]:
+    """{worker: [fault records]} from each worker's own
+    ``storage_faults.jsonl`` — the disk injector journals from INSIDE
+    the faulted process (train/storage.py), so its evidence lives next
+    to the worker's checkpoints, not in the supervisor's command
+    journal. Keyed by the logdir's worker id (the injector stamps the
+    same id on every record)."""
+    out: dict[int, list[dict]] = {}
+    for k, d in _worker_dirs(Path(trial_dir)).items():
+        recs = load_jsonl(d / "storage_faults.jsonl", schema.FAULT)
+        if recs:
+            out[k] = recs
+    return out
+
+
+def storage_exempt_targets(storage_faults: dict[int, list[dict]]
+                           ) -> dict[int, set[str]]:
+    """{worker: {artifact names}} the disk injector journaled as
+    deliberately corrupted (torn prefix / power-cut rename) — exempt
+    from invariant (5), same standing as the supervisor's
+    ``corrupt_latest_checkpoint`` targets."""
+    out: dict[int, set[str]] = {}
+    for k, recs in storage_faults.items():
+        for r in recs:
+            if (r.get("action") in _DISK_CORRUPT_ACTIONS
+                    and r.get("path")):
+                out.setdefault(k, set()).add(r["path"])
+    return out
+
+
+def check_storage_faults(trial_dir: str | Path,
+                         journal_records: list[dict],
+                         worker_events: dict[int, list[dict]] | None = None,
+                         storage_faults: dict[int, list[dict]] | None = None
+                         ) -> tuple[list[Violation], bool]:
+    """Invariant (14), replayed from artifacts alone. Returns
+    ``(violations, applicable)`` — not applicable (verdict: skipped)
+    when the trial shows no storage-fault evidence at all: no
+    journaled ``disk_*`` firing in any worker's storage_faults.jsonl
+    and no ``save_failed`` in any recovery journal.
+
+    Disk faults (train/storage.py) make durable writes FAIL or LIE —
+    a full disk mid-checkpoint, a write that lands only a prefix, a
+    rename whose data never hit the platter. The storage shim's claim
+    is graceful degradation plus crash consistency, and this invariant
+    holds the books to it:
+
+    * **every skipped save is licensed** — a ``save_failed`` record
+      (the trainer journaling that it SKIPPED a cadence save and kept
+      training) is legal only when that worker's injector journaled an
+      error-surfacing firing (ENOSPC / EIO / torn write); an
+      unlicensed save_failed is real storage damage nobody injected.
+    * **every fallback is licensed** — a worker whose restore walked
+      past a corrupt checkpoint (``corrupt_checkpoint_fallback`` /
+      ``fallback_restore``) must show an injected corruption for that
+      worker: a supervisor ``corrupt_latest_checkpoint`` firing or an
+      injector torn-write/crash-rename firing. Unlicensed corruption
+      at restore time means bytes rotted with no fault scripted.
+    * **no resumable bytes without a landed digest** — the atomic-save
+      protocol orders data → digest → pointer, so the pointer must
+      never name a single-file artifact whose digest sidecar is
+      missing, UNLESS a journaled process kill or disk firing explains
+      the gap (a crash between the digest unlink and rewrite of a
+      re-saved step is the one legal path to a pointed digest-less
+      file). A clean-run pointer past a missing digest is the
+      protocol writing the pointer early.
+    """
+    trial_dir = Path(trial_dir)
+    workers = _worker_dirs(trial_dir)
+    if storage_faults is None:
+        storage_faults = load_storage_faults(trial_dir)
+    if worker_events is None:
+        worker_events = {k: load_jsonl(d / "recovery_journal.jsonl",
+                                       schema.RECOVERY)
+                         for k, d in workers.items()}
+
+    fired_actions: dict[int, set[str]] = {
+        k: {str(r.get("action", "")) for r in recs}
+        for k, recs in storage_faults.items()}
+    save_failures: dict[int, int] = {}
+    for k, events in worker_events.items():
+        n = sum(1 for r in events if r.get("action") == "save_failed")
+        if n:
+            save_failures[k] = n
+
+    applicable = bool(storage_faults) or bool(save_failures)
+    if not applicable:
+        return [], False
+
+    out: list[Violation] = []
+    # supervisor-injected corruption and process kills also license
+    # what a restore finds (the training arm's corrupt+kill pairing)
+    sup_corrupted: set[int] = set()
+    killed: set[int] = set()
+    for r in journal_records:
+        if r.get("event") != schema.FAULT:
+            continue
+        if (r.get("action") == "corrupt_latest_checkpoint"
+                and isinstance(r.get("worker"), int)):
+            sup_corrupted.add(r["worker"])
+        elif (r.get("action") == "kill_worker"
+                and isinstance(r.get("worker"), int)):
+            killed.add(r["worker"])
+
+    for k, n in sorted(save_failures.items()):
+        errors = fired_actions.get(k, set()) & set(_DISK_ERROR_ACTIONS)
+        if not errors:
+            out.append(Violation(
+                "storage_faults",
+                f"{n} save_failed record(s) with no error-surfacing "
+                "disk firing journaled by this worker's injector — a "
+                "skipped cadence save nobody's fault plan licensed", k))
+
+    for k, events in sorted(worker_events.items()):
+        hit_corruption = any(
+            r.get("action") in ("corrupt_checkpoint_fallback",
+                                "fallback_restore")
+            for r in events)
+        if not hit_corruption:
+            continue
+        licensed = (k in sup_corrupted
+                    or bool(fired_actions.get(k, set())
+                            & set(_DISK_CORRUPT_ACTIONS)))
+        if not licensed:
+            out.append(Violation(
+                "storage_faults",
+                "restore fell back past a corrupt checkpoint with no "
+                "injected corruption (supervisor corrupt fault or "
+                "injector torn-write/crash-rename) journaled for this "
+                "worker", k))
+
+    for k, d in sorted(workers.items()):
+        pointer = d / "checkpoint.json"
+        if not pointer.exists():
+            continue
+        try:
+            latest = json.loads(pointer.read_text()).get("latest_path", "")
+        except (json.JSONDecodeError, AttributeError):
+            continue  # unreadable pointers are invariant (5)'s problem
+        if not str(latest).endswith(".msgpack"):
+            continue  # sharded saves point at a manifest (embedded
+            # checksum), not a digest-sidecar'd single file
+        target = d / str(latest)
+        sidecar = target.with_suffix(target.suffix + ".sha256")
+        if target.exists() and not sidecar.exists():
+            if k in killed or k in fired_actions:
+                continue  # a crash/fault can legally land between the
+                # digest unlink and rewrite of a re-saved step
+            out.append(Violation(
+                "storage_faults",
+                f"pointer names {target.name} whose digest sidecar "
+                "never landed, with no journaled kill or disk firing "
+                "to explain it — the save protocol published the "
+                "pointer before the digest", k))
+    return out, True
+
+
+# ---------------------------------------------------------------------------
 # whole-run replay
 # ---------------------------------------------------------------------------
 
@@ -1147,6 +1317,12 @@ def check_run(trial_dir: str | Path, outcome: dict | None = None,
                                    schema.RECOVERY)
                      for k, d in workers.items()}
     exempt = corruption_exempt_targets(journal_all)
+    # artifacts the workers' own disk injectors journaled as torn
+    # (train/storage.py) carry the same exemption standing as the
+    # supervisor's corrupt_latest_checkpoint targets
+    storage_faults = load_storage_faults(trial_dir)
+    for k, names in storage_exempt_targets(storage_faults).items():
+        exempt.setdefault(k, set()).update(names)
 
     violations: list[Violation] = []
     skipped: set[str] = set()
@@ -1205,6 +1381,14 @@ def check_run(trial_dir: str | Path, outcome: dict | None = None,
         # fault, a dedup hit, or a retried terminal) make the
         # exactly-once-under-retry claim
         skipped.add("net_faults")
+    storage_violations, storage_applicable = check_storage_faults(
+        trial_dir, journal_all, worker_events=worker_events,
+        storage_faults=storage_faults)
+    violations += storage_violations
+    if not storage_applicable:
+        # only trials with storage-fault evidence (a journaled disk_*
+        # firing or a save_failed) make the crash-consistency claim
+        skipped.add("storage_faults")
 
     restarts_by_worker: dict[int, int] = {}
     for r in recovery:

@@ -10,11 +10,56 @@ tools/benchmark.py:24-34, replaced by structured records).
 
 from __future__ import annotations
 
+import json
 from pathlib import Path
-from typing import Any
+from typing import Any, Iterator
 
 from . import schema
 from .report import load_jsonl
+
+
+def tail_records(path: str | Path | None = None, *,
+                 text: str | None = None,
+                 tail_bytes: int = 1 << 16) -> Iterator[dict]:
+    """Intact dict records from the tail of a live JSONL stream,
+    NEWEST FIRST.
+
+    The one torn-tail discipline every poll-loop reader shares: the
+    writer may be mid-append (or the tail window may start mid-line),
+    so blank, torn, and non-dict lines are skipped rather than treated
+    as evidence — a reader that reports "nothing" for a whole poll
+    tick because one line was torn makes live progress look stalled.
+    Callers filter for the record shape they want and stop at the
+    first hit; this generator does no more file I/O than the single
+    tail read.
+
+    Pass EITHER ``path`` (reads only the final ``tail_bytes`` of the
+    file; unreadable/missing file yields nothing) OR ``text`` (a tail
+    another transport already captured, e.g. a remote ``tail -n``
+    result). Distinct keywords, not one polymorphic argument: a str
+    path and a str blob are indistinguishable by type.
+    """
+    if (path is None) == (text is None):
+        raise ValueError("tail_records: pass exactly one of path/text")
+    if text is None:
+        try:
+            with open(Path(path), "rb") as f:
+                f.seek(0, 2)
+                size = f.tell()
+                f.seek(max(0, size - tail_bytes))
+                text = f.read().decode("utf-8", errors="replace")
+        except OSError:
+            return
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # torn write (or the window started mid-line)
+        if isinstance(rec, dict):
+            yield rec
 
 
 def load_journal(path: str | Path) -> list[dict]:
@@ -372,6 +417,42 @@ def summarize_net_chaos(trial_dir: str | Path) -> dict[str, Any] | None:
     return out
 
 
+def summarize_disk_chaos(trial_dir: str | Path) -> dict[str, Any] | None:
+    """One trial's storage-fault evidence, from artifacts alone: the
+    ``disk_*`` fault records each worker's injector (train/storage.py)
+    journaled into its own ``storage_faults.jsonl``, and the
+    degradation bookkeeping the trainer left behind — ``save_failed``
+    (a cadence save skipped under ENOSPC/EIO, training continued) and
+    ``fallback_restore`` (a restore that walked past a torn or
+    power-cut artifact) in each worker's ``recovery_journal.jsonl``.
+    Returns ``None`` when the trial carries no storage evidence at all
+    — the per-trial ``disk`` slot in the chaos report stays absent for
+    non-disk campaigns."""
+    trial_dir = Path(trial_dir)
+    by_action: dict[str, int] = {}
+    workers: set[int] = set()
+    for f in sorted(trial_dir.glob("worker*/storage_faults.jsonl")):
+        for r in load_jsonl(f, schema.FAULT):
+            a = str(r.get("action", ""))
+            if not a.startswith("disk_"):
+                continue
+            by_action[a] = by_action.get(a, 0) + 1
+            if isinstance(r.get("worker"), int):
+                workers.add(r["worker"])
+    save_failed = fallbacks = 0
+    for f in sorted(trial_dir.glob("worker*/recovery_journal.jsonl")):
+        for r in load_jsonl(f, schema.RECOVERY):
+            if r.get("action") == "save_failed":
+                save_failed += 1
+            elif r.get("action") == "fallback_restore":
+                fallbacks += 1
+    if not by_action and not save_failed:
+        return None
+    return {"faults": by_action, "fired": sum(by_action.values()),
+            "workers": sorted(workers), "save_failed": save_failed,
+            "fallback_restores": fallbacks}
+
+
 def summarize_chaos(path: str | Path) -> dict[str, Any]:
     """Aggregate a chaos campaign's ``chaos_report.jsonl`` (one
     ``event: "chaos_trial"`` record per trial, written by
@@ -392,6 +473,7 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
     autoscale_trials: list[dict[str, Any]] = []
     discipline_trials: list[dict[str, Any]] = []
     net_trials: list[dict[str, Any]] = []
+    disk_trials: list[dict[str, Any]] = []
     reconfigures = 0
     swaps_by_tier: dict[str, int] = {}
     quant_fallbacks = 0
@@ -457,6 +539,14 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
                 "retry_rate": nt.get("retry_rate"),
                 "attempts_p50": (nt.get("attempts") or {}).get("p50"),
                 "attempts_p99": (nt.get("attempts") or {}).get("p99")})
+        dk = rec.get("disk")
+        if dk is not None:
+            disk_trials.append({
+                "trial": rec.get("trial"),
+                "faults": dk.get("faults") or {},
+                "fired": dk.get("fired", 0),
+                "save_failed": dk.get("save_failed", 0),
+                "fallback_restores": dk.get("fallback_restores", 0)})
         f = rec.get("faults")
         if f is not None:
             fault_trials.append({"trial": rec.get("trial"),
@@ -616,7 +706,26 @@ def summarize_chaos(path: str | Path) -> dict[str, Any]:
                     (t["attempts_p99"] for t in net_trials
                      if t["attempts_p99"] is not None), default=None),
                 "per_trial": net_trials}
-                if net_trials else None)}
+                if net_trials else None),
+            # disk-mode campaigns: the storage-fault evidence per
+            # trial and in aggregate — firings by action, cadence
+            # saves skipped under injected ENOSPC/EIO, fallback
+            # restores past torn/power-cut artifacts — the nightly
+            # disk gate asserts faults fired (incl. a retry-exhausting
+            # ENOSPC) and invariant 14 green
+            "disk": ({
+                "trials": len(disk_trials),
+                "fired": sum(t["fired"] or 0 for t in disk_trials),
+                "faults_by_action": {
+                    k: sum((t["faults"] or {}).get(k, 0)
+                           for t in disk_trials)
+                    for t2 in disk_trials for k in (t2["faults"] or {})},
+                "save_failed": sum(t["save_failed"] or 0
+                                   for t in disk_trials),
+                "fallback_restores": sum(t["fallback_restores"] or 0
+                                         for t in disk_trials),
+                "per_trial": disk_trials}
+                if disk_trials else None)}
 
 
 def summarize_journal(path: str | Path) -> dict[str, Any]:

@@ -150,7 +150,13 @@ _declare(EventSchema(
         "rollback_candidate_unusable": _act(("step", "error")),
         "rollback_candidate_poisoned": _act(("step",)),
         "preempt_flush": _act(("signal", "step")),
-        # checkpoint layer (train/checkpoint.py, parallel/api.py)
+        # checkpoint layer (train/checkpoint.py, parallel/api.py).
+        # ``save_failed`` is the graceful ENOSPC/EIO degradation: a
+        # cadence save that still failed after the bounded I/O retries
+        # was journaled and SKIPPED (train/loop.py) — the
+        # ``storage_faults`` invariant licenses every one against an
+        # injected disk fault.
+        "save_failed": _act(("step", "error"), ("errno", "where")),
         "follow_skip": _act(("step", "error")),
         "corrupt_checkpoint_fallback": _act(("bad_step", "error")),
         "fallback_restore": _act(("step",)),
@@ -341,6 +347,22 @@ _declare(EventSchema(
         "net_blackhole": _act(("hold_s",), ("conn",)),
         "net_partition": _act(("start_s", "duration_s"),
                               ("conns_dropped",)),
+        # -- storage faults (train/storage.py DiskFaultInjector) -------
+        # journaled by the WORKER process into its own
+        # storage_faults.jsonl (a worker cannot reach the supervisor's
+        # command journal); ``path`` is the durable artifact the op
+        # targeted, ``at_step`` the trainer step the injector last saw,
+        # ``planned_step`` the script's arming step.
+        "disk_enospc": _act(("path", "op"),
+                            ("at_step", "planned_step", "budget_bytes")),
+        "disk_eio": _act(("path", "op", "nth"),
+                         ("at_step", "planned_step")),
+        "disk_slow_io": _act(("path", "op", "ms"),
+                             ("at_step", "planned_step")),
+        "disk_torn_write": _act(("path", "at_byte"),
+                                ("at_step", "planned_step", "op")),
+        "disk_crash_rename": _act(("path", "kept_bytes"),
+                                  ("at_step", "planned_step")),
     },
 ))
 
@@ -372,7 +394,8 @@ _declare(EventSchema(
               "step", "target", "duration_s", "verdicts", "violations"),
     optional=("mttr", "boot_s", "stall_timeout_s", "faults",
               "reconfigures", "final_world", "serving", "serve_swaps",
-              "shrunk", "broker", "autoscale", "discipline", "net"),
+              "shrunk", "broker", "autoscale", "discipline", "net",
+              "disk"),
 ))
 
 # Continuous evaluator (evalsvc/evaluator.py eval_log.jsonl).

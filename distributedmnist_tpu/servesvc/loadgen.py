@@ -26,6 +26,7 @@ from typing import Any, Callable
 import numpy as np
 
 from ..core.log import JsonlSink, get_logger
+from ..obsv.journal import tail_records
 from .client import ServeClient
 
 logger = get_logger("loadgen")
@@ -233,27 +234,11 @@ def read_latest_window(journal_path: str | Path,
                        tail_bytes: int = 1 << 16) -> dict | None:
     """The newest ``window`` snapshot in a (possibly still-growing)
     loadgen journal, or None. Reads only the file tail and scans
-    backwards past torn lines — the broker polls this every second
-    against a journal another process is appending to."""
-    path = Path(journal_path)
-    try:
-        with open(path, "rb") as f:
-            f.seek(0, 2)
-            size = f.tell()
-            f.seek(max(0, size - tail_bytes))
-            chunk = f.read().decode("utf-8", errors="replace")
-    except OSError:
-        return None
-    for line in reversed(chunk.splitlines()):
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            rec = json.loads(line)
-        except json.JSONDecodeError:
-            continue  # torn head/tail line
-        if (isinstance(rec, dict) and rec.get("event") == "load"
-                and rec.get("action") == "window"):
+    backwards past torn lines (obsv/journal.py ``tail_records``) — the
+    broker polls this every second against a journal another process
+    is appending to."""
+    for rec in tail_records(journal_path, tail_bytes=tail_bytes):
+        if rec.get("event") == "load" and rec.get("action") == "window":
             return rec
     return None
 

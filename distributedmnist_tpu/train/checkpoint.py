@@ -57,6 +57,7 @@ import numpy as np
 from flax import serialization
 
 from ..core.log import get_logger
+from . import storage
 
 logger = get_logger("checkpoint")
 
@@ -277,7 +278,7 @@ def _digest_path(path: Path) -> Path:
 def _write_atomic(path: Path, data: bytes, digest: bool = True) -> None:
     def write() -> None:
         tmp = path.with_suffix(path.suffix + ".tmp")
-        tmp.write_bytes(data)
+        storage.write_bytes(tmp, data, role="data")
         dpath = _digest_path(path)
         if digest:
             # drop any PREVIOUS sidecar before the data lands: this
@@ -287,11 +288,12 @@ def _write_atomic(path: Path, data: bytes, digest: bool = True) -> None:
             # (legacy-accepted) file — never old-digest-over-new-bytes,
             # which would reject a perfectly good checkpoint
             dpath.unlink(missing_ok=True)
-        os.replace(tmp, path)
+        storage.replace(tmp, path, role="data")
         if digest:
             dtmp = dpath.with_name(dpath.name + ".tmp")
-            dtmp.write_text(hashlib.sha256(data).hexdigest())
-            os.replace(dtmp, dpath)
+            storage.write_text(dtmp, hashlib.sha256(data).hexdigest(),
+                               role="sidecar")
+            storage.replace(dtmp, dpath, role="sidecar")
     _io_retries(write, path.name)
 
 
@@ -300,10 +302,11 @@ def _verified_read(path: Path) -> bytes:
     digest sidecar when one exists — a file without a sidecar is
     accepted as-is (pre-checksum layout, or a crash between data and
     digest writes)."""
-    data = _io_retries(path.read_bytes, path.name)
+    data = _io_retries(lambda: storage.read_bytes(path), path.name)
     dpath = _digest_path(path)
     if dpath.exists():
-        want = _io_retries(dpath.read_text, dpath.name).strip()
+        want = _io_retries(lambda: storage.read_text(dpath),
+                           dpath.name).strip()
         got = hashlib.sha256(data).hexdigest()
         if want and got != want:
             raise CheckpointCorruptError(
@@ -339,7 +342,7 @@ def _manifest_checksum(manifest: dict) -> str:
 
 def _read_manifest(train_dir: Path, step: int) -> dict:
     mpath = _manifest_path(train_dir, step)
-    text = _io_retries(mpath.read_text, mpath.name)
+    text = _io_retries(lambda: storage.read_text(mpath), mpath.name)
     try:
         manifest = json.loads(text)
     except json.JSONDecodeError as e:
@@ -355,8 +358,8 @@ def _write_pointer(train_dir: Path, step: int, latest_name: str) -> None:
     pointer = {"latest_step": step, "latest_path": latest_name,
                "written_at": time.time()}
     ptmp = train_dir / (_POINTER + ".tmp")
-    ptmp.write_text(json.dumps(pointer))
-    os.replace(ptmp, train_dir / _POINTER)
+    storage.write_text(ptmp, json.dumps(pointer), role="pointer")
+    storage.replace(ptmp, train_dir / _POINTER, role="pointer")
 
 
 def save_checkpoint(train_dir: str | Path, state: Any, step: int,
@@ -428,7 +431,8 @@ class AsyncCheckpointer:
     not a journal. Worker errors surface on the next ``save``/``wait``.
     """
 
-    def __init__(self, max_consecutive_failures: int = 3):
+    def __init__(self, max_consecutive_failures: int = 3,
+                 on_error: Callable[[int, Exception], None] | None = None):
         self._lock = threading.Lock()
         self._pending: tuple | None = None
         self._busy = False
@@ -436,6 +440,12 @@ class AsyncCheckpointer:
         self._last_failure: Exception | None = None  # never cleared by wait()
         self._consecutive_failures = 0
         self.max_consecutive_failures = max_consecutive_failures
+        # Journal hook ``(step, exception)`` for a failed write — the
+        # trainer records a schema-declared ``save_failed`` recovery
+        # event so a skipped cadence save is auditable evidence, not
+        # just a log line (obsv/invariants.py storage_faults licenses
+        # it against the injected disk fault that caused it).
+        self._on_error = on_error
         self._wake = threading.Condition(self._lock)
         self._stop = False
         self.closed = False
@@ -485,6 +495,11 @@ class AsyncCheckpointer:
                     self._error = e
                     self._last_failure = e
                     self._consecutive_failures += 1
+                if self._on_error is not None:
+                    try:
+                        self._on_error(job[2], e)
+                    except Exception:
+                        logger.exception("checkpoint on_error hook failed")
             else:
                 with self._lock:
                     self._error = None  # a later success supersedes
@@ -606,10 +621,10 @@ def latest_checkpoint_step(train_dir: str | Path) -> int | None:
     ptr = train_dir / _POINTER
     if ptr.exists():
         try:
-            d = json.loads(ptr.read_text())
+            d = json.loads(storage.read_text(ptr))
             if (train_dir / d["latest_path"]).exists():
                 return int(d["latest_step"])
-        except (json.JSONDecodeError, KeyError, ValueError):
+        except (json.JSONDecodeError, KeyError, ValueError, OSError):
             pass
     steps = _loadable_steps(train_dir)
     if not steps:
@@ -882,7 +897,7 @@ def artifact_digest(train_dir: str | Path, step: int) -> str | None:
     train_dir = Path(train_dir)
     dpath = _digest_path(_ckpt_path(train_dir, step))
     try:
-        return dpath.read_text().strip() or None
+        return storage.read_text(dpath).strip() or None
     except OSError:
         return None
 
@@ -941,7 +956,7 @@ def quant_sidecar_digest(train_dir: str | Path, step: int) -> str | None:
     exists."""
     dpath = _digest_path(quant_sidecar_path(train_dir, step))
     try:
-        return dpath.read_text().strip() or None
+        return storage.read_text(dpath).strip() or None
     except OSError:
         return None
 

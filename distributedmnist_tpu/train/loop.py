@@ -36,6 +36,7 @@ from ..parallel.api import (TrainState, build_eval_step, build_train_step,
                             state_partition_specs, world_signature,
                             zero1_plan_for)
 from . import checkpoint as ckpt
+from . import storage
 from .lr_schedule import (constant, decay_steps_for, exponential_decay,
                           warmup_polynomial_decay)
 
@@ -233,6 +234,10 @@ class Trainer:
         self.device_work_injection: dict[int, tuple] | None = None
         self.is_writer = jax.process_index() == 0
         self.train_dir = Path(cfg.train.train_dir)
+        # Install the fsync policy process-wide BEFORE any durable
+        # write (including the resume below) — an unknown value is a
+        # typed ConfigError at trainer build, not a downstream surprise
+        storage.set_durability(cfg.train.durability)
         self._sharded_ckpt = ckpt.state_needs_sharded_save(self.state)
         self._use_async_ckpt = cfg.train.async_checkpoint and (
             self.is_writer or self._sharded_ckpt)
@@ -415,6 +420,55 @@ class Trainer:
         if self._quant_publisher is not None and self.is_writer:
             pub, tdir = self._quant_publisher, self.train_dir
             publish = lambda st, s: pub.publish(tdir, st, s)  # noqa: E731
+        # arm any at_step-gated disk fault scripts for this save
+        storage.note_step(at_step)
+        try:
+            self._save_inner(at_step, extra, publish)
+        except OSError as e:
+            # Graceful ENOSPC/EIO degradation: a cadence save that
+            # still fails after the bounded I/O retries is journaled
+            # and SKIPPED — the run keeps training and the next
+            # cadence tries again (async writes report here through
+            # the checkpointer's on_error hook instead; a persistently
+            # dead disk still stops the run via its consecutive-
+            # failure bound).
+            logger.error("checkpoint save for step=%d failed (%s) — "
+                         "skipping this cadence", at_step, e)
+            self._recovery_event({"layer": "train",
+                                  "action": "save_failed",
+                                  "step": at_step,
+                                  "error": f"{type(e).__name__}: {e}",
+                                  "errno": getattr(e, "errno", None),
+                                  "where": "sync"})
+            self._last_save_time = time.time()
+            return
+        # what the step loop actually paid for this save — the quantity
+        # the save_stall bench gates (async-snapshot dispatch vs the
+        # sync host fetch + canonical conversion)
+        stall_ms = (time.perf_counter() - t0) * 1e3
+        self.collector.add_snapshot_stall_ms(stall_ms)
+        # "at_step", deliberately NOT "step": the log-tail parsers
+        # (launch/cluster.py parse_poll_output and the resume watch)
+        # treat any intact record carrying "step" as training progress
+        self._sink_write({"event": "save", "time": time.time(),
+                          "at_step": at_step,
+                          "save_stall_ms": round(stall_ms, 3),
+                          "async_snapshot": self._async_snapshot,
+                          **({"quant_tiers":
+                              list(self._quant_publisher.tiers)}
+                             if publish is not None else {})})
+        self._last_save_time = time.time()
+
+    def _ckpt_save_failed(self, step: int, e: Exception) -> None:
+        """AsyncCheckpointer on_error hook (worker thread): journal the
+        failed background write as a ``save_failed`` recovery event."""
+        self._recovery_event({"layer": "train", "action": "save_failed",
+                              "step": step,
+                              "error": f"{type(e).__name__}: {e}",
+                              "errno": getattr(e, "errno", None),
+                              "where": "async"})
+
+    def _save_inner(self, at_step: int, extra: dict, publish) -> None:
         if self._async_snapshot:
             # donation-safe snapshot, backend-matched (both variants
             # leave the canonical-layout conversion + the state-dict
@@ -432,7 +486,8 @@ class Trainer:
             #     buffers first); device_get here would be the
             #     blocking D2H stall this knob exists to remove.
             if self._checkpointer is None or self._checkpointer.closed:
-                self._checkpointer = ckpt.AsyncCheckpointer()
+                self._checkpointer = ckpt.AsyncCheckpointer(
+                    on_error=self._ckpt_save_failed)
             plan = self._zero1_plan
             if jax.default_backend() == "cpu":
                 snap = ckpt.host_view_snapshot(self.state)
@@ -466,7 +521,8 @@ class Trainer:
                                                      self._zero1_plan)
             if self._use_async_ckpt:
                 if self._checkpointer is None or self._checkpointer.closed:
-                    self._checkpointer = ckpt.AsyncCheckpointer()
+                    self._checkpointer = ckpt.AsyncCheckpointer(
+                        on_error=self._ckpt_save_failed)
                 self._checkpointer.save(self.train_dir, state_to_save,
                                         at_step, extra=extra,
                                         keep=self.cfg.train.keep_checkpoints,
@@ -478,22 +534,6 @@ class Trainer:
                 ckpt.save_checkpoint(self.train_dir, state_to_save, at_step,
                                      extra=extra,
                                      keep=self.cfg.train.keep_checkpoints)
-        # what the step loop actually paid for this save — the quantity
-        # the save_stall bench gates (async-snapshot dispatch vs the
-        # sync host fetch + canonical conversion)
-        stall_ms = (time.perf_counter() - t0) * 1e3
-        self.collector.add_snapshot_stall_ms(stall_ms)
-        # "at_step", deliberately NOT "step": the log-tail parsers
-        # (launch/cluster.py parse_poll_output and the resume watch)
-        # treat any intact record carrying "step" as training progress
-        self._sink_write({"event": "save", "time": time.time(),
-                          "at_step": at_step,
-                          "save_stall_ms": round(stall_ms, 3),
-                          "async_snapshot": self._async_snapshot,
-                          **({"quant_tiers":
-                              list(self._quant_publisher.tiers)}
-                             if publish is not None else {})})
-        self._last_save_time = time.time()
 
     def _rollback_to_last_good(self, err: _NonFiniteLoss) -> int:
         """NaN-guard rollback: restore the newest checkpoint whose

@@ -23,7 +23,8 @@ import pytest
 from distributedmnist_tpu.analysis import (CHECKERS, iter_sources,
                                            load_baseline, run_checkers)
 from distributedmnist_tpu.analysis.core import Source
-from distributedmnist_tpu.analysis import (config_check, jax_check,
+from distributedmnist_tpu.analysis import (config_check,
+                                           durability_check, jax_check,
                                            net_check, schema_check,
                                            threads_check)
 from distributedmnist_tpu.obsv import schema
@@ -277,6 +278,76 @@ class TestNetChecker:
         srcs = iter_sources([PKG / "servesvc", PKG / "launch"],
                             repo_root=REPO)
         got = net_check.check(srcs)
+        assert got == [], [f.key for f in got]
+
+
+# ---------------------------------------------------------------------------
+# durability checker fixtures
+# ---------------------------------------------------------------------------
+
+class TestDurabilityChecker:
+    def check(self, text: str,
+              path: str = "distributedmnist_tpu/train/snippet.py"):
+        return durability_check.check([src(path, text)])
+
+    def test_raw_write_in_train_flagged(self):
+        # in the checkpoint-owning package ANY raw write is a bypass
+        got = self.check(
+            "def save(p, data):\n"
+            '    with open(p, "wb") as fh:\n'
+            "        fh.write(data)\n")
+        assert any('save.open(mode="wb")' in k for k in keys(got))
+
+    def test_raw_rename_and_path_writes_in_train_flagged(self):
+        got = self.check(
+            "import os\n"
+            "def publish(tmp, dst):\n"
+            "    dst.write_bytes(b'x')\n"
+            "    os.replace(tmp, dst)\n")
+        assert any("publish.write_bytes()" in k for k in keys(got))
+        assert any("publish.os.replace()" in k for k in keys(got))
+
+    def test_shim_routed_calls_clean(self):
+        got = self.check(
+            "from . import storage\n"
+            "def save(tmp, dst, data):\n"
+            '    storage.write_bytes(tmp, data, role="data")\n'
+            '    storage.replace(tmp, dst, role="data")\n')
+        assert got == []
+
+    def test_reads_and_nonliteral_modes_clean(self):
+        got = self.check(
+            "def load(p, mode):\n"
+            '    with open(p) as a, open(p, "rb") as b:\n'
+            "        pass\n"
+            "    return open(p, mode)\n")
+        assert got == []
+
+    def test_elsewhere_only_durable_paths_flagged(self):
+        launch = "distributedmnist_tpu/launch/snippet.py"
+        # a supervisor writing its own results file is out of scope
+        assert self.check(
+            'def report(d):\n'
+            '    (d / "results.json").write_text("{}")\n',
+            path=launch) == []
+        # ... but writing a checkpoint pointer behind the shim is not
+        got = self.check(
+            'def meddle(d):\n'
+            '    (d / "checkpoint.json").write_text("{}")\n',
+            path=launch)
+        assert any("meddle.write_text()" in k for k in keys(got))
+
+    def test_shim_and_tests_exempt(self):
+        bad = 'def f(p):\n    open(p, "w").write("x")\n'
+        assert self.check(
+            bad, path="distributedmnist_tpu/train/storage.py") == []
+        assert self.check(bad, path="tests/test_x.py") == []
+
+    def test_real_durable_write_paths_are_clean(self):
+        # the lint's reason to exist: every durable write the train/
+        # quant stack ships today routes through the storage shim
+        srcs = iter_sources([PKG], repo_root=REPO)
+        got = durability_check.check(srcs)
         assert got == [], [f.key for f in got]
 
 
@@ -641,7 +712,7 @@ class TestSelfCheck:
     def test_all_checkers_registered(self):
         run_checkers([])  # force registration imports
         assert set(CHECKERS) == {"schema", "config", "threads", "jax",
-                                 "paged", "net"}
+                                 "paged", "net", "durability"}
 
     def test_baseline_entries_carry_justifications(self):
         raw = json.loads(

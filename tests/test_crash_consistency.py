@@ -1,0 +1,373 @@
+"""Crash-point matrix over the atomic-save protocol (ISSUE 20).
+
+``save_checkpoint`` promises: tmp write → data rename → digest sidecar
+→ latest-pointer, each step leaving the directory restorable.  This
+suite enumerates every crash point in that chain — via the storage
+shim's deterministic disk faults (train/storage.py) where the fault
+model covers it, and via a SimulatedCrash (a non-OSError, so the
+retry wrapper propagates it like a process death) where the crash
+must land BETWEEN shim ops — and proves, from the artifacts alone,
+that restore lands on the last durable step with the fallback cause
+journaled, and that every degradation the run booked is licensed by
+an injected fault (obsv/invariants.py ``storage_faults``).
+"""
+
+import errno
+import json
+
+import numpy as np
+import pytest
+
+from distributedmnist_tpu.obsv.invariants import (
+    Violation, check_checkpoint_dir, check_storage_faults,
+    load_storage_faults, storage_exempt_targets)
+from distributedmnist_tpu.obsv.report import load_jsonl
+from distributedmnist_tpu.train import checkpoint as ckpt
+from distributedmnist_tpu.train import storage
+
+
+class SimulatedCrash(Exception):
+    """Process death between shim ops: NOT an OSError, so
+    ``_io_retries`` propagates it immediately instead of retrying —
+    exactly what a power cut does to the protocol."""
+
+
+@pytest.fixture(autouse=True)
+def _disarm_storage_faults():
+    storage.clear_faults()
+    yield
+    storage.clear_faults()
+
+
+def _dict_state(v: int):
+    return {"params": {"w": np.full((4, 3), float(v), np.float32)},
+            "step": np.int32(v)}
+
+
+def _restored_value(tmp_path, events=None):
+    got = ckpt.restore_checkpoint(
+        tmp_path, _dict_state(0),
+        on_event=events.append if events is not None else None)
+    assert got is not None
+    state, _, step = got
+    return step, float(state["params"]["w"][0, 0])
+
+
+def _crash_in(monkeypatch, fn_name, role):
+    """Crash the FIRST shim call of ``fn_name`` made with ``role``."""
+    real = getattr(storage, fn_name)
+
+    def boom(*args, **kwargs):
+        if kwargs.get("role", args[2] if len(args) > 2 else None) == role:
+            raise SimulatedCrash(f"{fn_name}(role={role})")
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(storage, fn_name, boom)
+
+
+# ---------------------------------------------------------------------------
+# the matrix: one test per crash point in the atomic-save chain
+# ---------------------------------------------------------------------------
+
+@pytest.mark.tier1
+def test_crash_mid_tmp_write_torn_at_byte(tmp_path):
+    """Point 1: the tmp write lands only a prefix (torn_write fault,
+    times = the full retry budget so the save fails all the way
+    through).  The torn ``.tmp`` is never a restore candidate: restore
+    lands on the previous step with no fallback event — the crash cost
+    a cadence, not consistency."""
+    ckpt.save_checkpoint(tmp_path, _dict_state(3), 3)
+    journal = tmp_path / "storage_faults.jsonl"
+    storage.arm_faults(0, [{"kind": "torn_write_at_byte", "at_byte": 37,
+                            "match": ".msgpack",
+                            "times": ckpt._IO_ATTEMPTS}], journal)
+    with pytest.raises(OSError) as ei:
+        ckpt.save_checkpoint(tmp_path, _dict_state(6), 6)
+    assert ei.value.errno == errno.EIO
+    torn = tmp_path / "ckpt-00000006.msgpack.tmp"
+    assert torn.exists() and torn.stat().st_size == 37
+    events = []
+    assert _restored_value(tmp_path, events) == (3, 3.0)
+    assert events == []  # the torn tmp was never a candidate
+    actions = [r["action"] for r in load_jsonl(journal)]
+    assert actions == ["disk_torn_write"] * ckpt._IO_ATTEMPTS
+
+
+@pytest.mark.tier1
+def test_crash_post_tmp_pre_rename(tmp_path, monkeypatch):
+    """Point 2: tmp fully written, crash before the data rename.  The
+    complete ``.tmp`` is still not a candidate — restore lands on the
+    previous step and the stale tmp is later GC-proof (skipped)."""
+    ckpt.save_checkpoint(tmp_path, _dict_state(3), 3)
+    _crash_in(monkeypatch, "replace", "data")
+    with pytest.raises(SimulatedCrash):
+        ckpt.save_checkpoint(tmp_path, _dict_state(6), 6)
+    assert (tmp_path / "ckpt-00000006.msgpack.tmp").exists()
+    assert not (tmp_path / "ckpt-00000006.msgpack").exists()
+    assert _restored_value(tmp_path) == (3, 3.0)
+    assert ckpt.latest_checkpoint_step(tmp_path) == 3
+
+
+@pytest.mark.tier1
+def test_crash_post_rename_pre_digest(tmp_path, monkeypatch):
+    """Point 3: data renamed into place, crash before the digest
+    sidecar lands.  The digest-less file is legacy-accepted (the
+    protocol unlinks the OLD digest first, so stale-digest-over-new-
+    bytes can never reject it): restore lands on the NEW step; the
+    pointer — never updated — still names the old one, which is the
+    licensed digest-gap shape invariant 14 accepts."""
+    ckpt.save_checkpoint(tmp_path, _dict_state(3), 3)
+    _crash_in(monkeypatch, "write_text", "sidecar")
+    with pytest.raises(SimulatedCrash):
+        ckpt.save_checkpoint(tmp_path, _dict_state(6), 6)
+    assert (tmp_path / "ckpt-00000006.msgpack").exists()
+    assert not (tmp_path / "ckpt-00000006.msgpack.sha256").exists()
+    assert _restored_value(tmp_path) == (6, 6.0)
+    ptr = json.loads((tmp_path / "checkpoint.json").read_text())
+    assert ptr["latest_step"] == 3
+
+
+@pytest.mark.tier1
+def test_crash_post_digest_pre_pointer(tmp_path, monkeypatch):
+    """Point 4: artifact and digest fully durable, crash before the
+    pointer write.  The step is restorable (the scan unions with the
+    pointer), nothing is corrupt, and the digest verifies."""
+    ckpt.save_checkpoint(tmp_path, _dict_state(3), 3)
+    _crash_in(monkeypatch, "write_text", "pointer")
+    with pytest.raises(SimulatedCrash):
+        ckpt.save_checkpoint(tmp_path, _dict_state(6), 6)
+    assert (tmp_path / "ckpt-00000006.msgpack.sha256").exists()
+    ckpt.verify_artifact(tmp_path / "ckpt-00000006.msgpack")
+    assert _restored_value(tmp_path) == (6, 6.0)
+    assert json.loads(
+        (tmp_path / "checkpoint.json").read_text())["latest_step"] == 3
+
+
+@pytest.mark.tier1
+def test_crash_mid_pointer(tmp_path, monkeypatch):
+    """Point 5: crash between the pointer's tmp write and its rename
+    (and, separately, a torn pointer body): ``checkpoint.json`` is
+    either the intact OLD pointer or unreadable — both fall back to
+    the directory scan and land on the newest durable step."""
+    ckpt.save_checkpoint(tmp_path, _dict_state(3), 3)
+    _crash_in(monkeypatch, "replace", "pointer")
+    with pytest.raises(SimulatedCrash):
+        ckpt.save_checkpoint(tmp_path, _dict_state(6), 6)
+    monkeypatch.undo()
+    assert (tmp_path / "checkpoint.json.tmp").exists()
+    assert json.loads(
+        (tmp_path / "checkpoint.json").read_text())["latest_step"] == 3
+    # restore unions the directory scan with the (stale) pointer and
+    # tries newest-first: the fully-durable step 6 wins
+    assert _restored_value(tmp_path) == (6, 6.0)
+    # a non-atomic legacy overwrite that tore mid-body: scan fallback
+    (tmp_path / "checkpoint.json").write_text('{"latest_step": 6, "la')
+    assert ckpt.latest_checkpoint_step(tmp_path) == 6
+
+
+@pytest.mark.tier1
+def test_enospc_exhausts_retries_and_leaves_dir_restorable(tmp_path):
+    """A full disk across the whole retry budget: the save raises
+    ENOSPC having written NOTHING durable; restore lands on the
+    previous step and every firing is journaled for licensing."""
+    ckpt.save_checkpoint(tmp_path, _dict_state(3), 3)
+    journal = tmp_path / "storage_faults.jsonl"
+    storage.arm_faults(0, [{"kind": "enospc_after_bytes", "bytes": 0,
+                            "match": ".msgpack",
+                            "times": ckpt._IO_ATTEMPTS}], journal)
+    with pytest.raises(OSError) as ei:
+        ckpt.save_checkpoint(tmp_path, _dict_state(6), 6)
+    assert ei.value.errno == errno.ENOSPC
+    assert not (tmp_path / "ckpt-00000006.msgpack").exists()
+    assert _restored_value(tmp_path) == (3, 3.0)
+    recs = load_jsonl(journal)
+    assert [r["action"] for r in recs] == \
+        ["disk_enospc"] * ckpt._IO_ATTEMPTS
+    assert all(r["worker"] == 0 for r in recs)
+
+
+@pytest.mark.tier1
+def test_transient_fault_absorbed_by_retries(tmp_path):
+    """One EIO firing inside a 3-attempt budget: the save SUCCEEDS,
+    the firing is still journaled — licensing is 'a fault fired', not
+    'a save failed', so absorbed faults stay visible."""
+    journal = tmp_path / "storage_faults.jsonl"
+    storage.arm_faults(0, [{"kind": "eio", "op": "write", "nth": 1,
+                            "match": ".msgpack", "times": 1}], journal)
+    ckpt.save_checkpoint(tmp_path, _dict_state(6), 6)
+    assert _restored_value(tmp_path) == (6, 6.0)
+    assert [r["action"] for r in load_jsonl(journal)] == ["disk_eio"]
+
+
+@pytest.mark.tier1
+def test_crash_rename_falls_back_with_journaled_cause(tmp_path):
+    """The power-cut model: rename applied, data never hit the
+    platter.  The writer believes the save succeeded (no error), the
+    pointer names the hollow artifact — and the digest sidecar catches
+    it at restore: fallback to the previous step with BOTH the cause
+    and the fallback journaled, plus the injector's own license."""
+    ckpt.save_checkpoint(tmp_path, _dict_state(3), 3)
+    journal = tmp_path / "storage_faults.jsonl"
+    storage.arm_faults(0, [{"kind": "crash_rename",
+                            "match": "ckpt-00000006.msgpack",
+                            "times": 1}], journal)
+    ckpt.save_checkpoint(tmp_path, _dict_state(6), 6)  # "succeeds"
+    assert (tmp_path / "ckpt-00000006.msgpack").stat().st_size == 0
+    assert json.loads(
+        (tmp_path / "checkpoint.json").read_text())["latest_step"] == 6
+    events = []
+    assert _restored_value(tmp_path, events) == (3, 3.0)
+    actions = {e["action"]: e for e in events}
+    assert actions["corrupt_checkpoint_fallback"]["bad_step"] == 6
+    assert actions["fallback_restore"]["step"] == 3
+    assert [r["action"] for r in load_jsonl(journal)] == \
+        ["disk_crash_rename"]
+
+
+@pytest.mark.tier1
+def test_at_step_gating_arms_scripts_late(tmp_path):
+    """``at_step`` holds a script quiet until the trainer reports
+    progress past it — the chaos schedule's step axis."""
+    journal = tmp_path / "storage_faults.jsonl"
+    storage.arm_faults(0, [{"kind": "eio", "op": "write", "nth": 1,
+                            "at_step": 10, "match": ".msgpack",
+                            "times": ckpt._IO_ATTEMPTS}], journal)
+    ckpt.save_checkpoint(tmp_path, _dict_state(5), 5)  # before: quiet
+    storage.note_step(10)
+    with pytest.raises(OSError):
+        ckpt.save_checkpoint(tmp_path, _dict_state(10), 10)
+    assert _restored_value(tmp_path) == (5, 5.0)
+
+
+# ---------------------------------------------------------------------------
+# invariant 14: licensing + exemptions replay from the artifacts
+# ---------------------------------------------------------------------------
+
+def _worker_trial(tmp_path):
+    d = tmp_path / "worker0"
+    d.mkdir(parents=True, exist_ok=True)
+    return tmp_path, d
+
+
+def _recovery(d, records):
+    with open(d / "recovery_journal.jsonl", "a") as fh:
+        for r in records:
+            fh.write(json.dumps({"event": "recovery", **r}) + "\n")
+
+
+@pytest.mark.tier1
+def test_storage_faults_invariant_licenses_real_run(tmp_path):
+    """End-to-end licensing: a crash_rename trial's artifacts — the
+    injector journal, the hollow artifact, the fallback events — must
+    replay green, and invariant 5 must accept the torn target ONLY
+    through the storage exemption."""
+    trial, d = _worker_trial(tmp_path)
+    ckpt.save_checkpoint(d, _dict_state(3), 3)
+    storage.arm_faults(0, [{"kind": "crash_rename",
+                            "match": "ckpt-00000006.msgpack"}],
+                       d / "storage_faults.jsonl")
+    ckpt.save_checkpoint(d, _dict_state(6), 6)
+    events = []
+    ckpt.restore_checkpoint(d, _dict_state(0), on_event=events.append)
+    storage.clear_faults()  # flush the injector's journal sink
+    _recovery(d, [{"layer": "checkpoint", **e} for e in events])
+
+    sf = load_storage_faults(trial)
+    assert [r["action"] for r in sf[0]] == ["disk_crash_rename"]
+    violations, applicable = check_storage_faults(trial, [])
+    assert applicable and violations == []
+    # invariant 5: damaged WITHOUT the exemption, green with it
+    exempt = storage_exempt_targets(sf)
+    assert exempt == {0: {"ckpt-00000006.msgpack"}}
+    assert check_checkpoint_dir(d, exempt[0], worker=0) == []
+    assert any(v.invariant == "checkpoint_integrity"
+               for v in check_checkpoint_dir(d, set(), worker=0))
+
+
+@pytest.mark.tier1
+def test_storage_faults_invariant_flags_unlicensed_damage(tmp_path):
+    """The other half of the licensing books: a save_failed nobody
+    injected, a fallback with no scripted corruption, and a pointer
+    past a missing digest in a clean run are each violations; a trial
+    with no storage evidence at all is skipped, not passed."""
+    trial, d = _worker_trial(tmp_path)
+    violations, applicable = check_storage_faults(trial, [])
+    assert not applicable and violations == []
+
+    _recovery(d, [{"action": "save_failed", "step": 5,
+                   "error": "OSError: nobody injected this"}])
+    violations, applicable = check_storage_faults(trial, [])
+    assert applicable
+    assert [v.invariant for v in violations] == ["storage_faults"]
+    assert "save_failed" in violations[0].detail
+
+    (d / "recovery_journal.jsonl").unlink()
+    _recovery(d, [{"action": "corrupt_checkpoint_fallback", "bad_step": 6,
+                   "error": "CheckpointCorruptError: rot"},
+                  {"action": "fallback_restore", "step": 3}])
+    # a slow-io firing makes the trial applicable but corrupts nothing
+    # — it cannot license a restore walking past rotten bytes
+    with open(d / "storage_faults.jsonl", "w") as fh:
+        fh.write(json.dumps({"event": "fault", "action": "disk_slow_io",
+                             "worker": 0, "path": "ckpt-00000006.msgpack.tmp",
+                             "op": "write", "ms": 5.0}) + "\n")
+    violations, _ = check_storage_faults(trial, [])
+    assert any("no injected corruption" in v.detail for v in violations)
+    # a supervisor corrupt_latest_checkpoint firing licenses the same
+    licensed, _ = check_storage_faults(
+        trial, [{"event": "fault", "action": "corrupt_latest_checkpoint",
+                 "worker": 0, "target": "ckpt-00000006.msgpack"}])
+    assert licensed == []
+
+    # pointer published past a digest that never landed, clean run
+    (d / "recovery_journal.jsonl").unlink()
+    ckpt.save_checkpoint(d, _dict_state(6), 6)
+    (d / "ckpt-00000006.msgpack.sha256").unlink()
+    _recovery(d, [{"action": "save_failed", "step": 9, "error": "x"}])
+    sf_journal = d / "storage_faults.jsonl"
+    with open(sf_journal, "w") as fh:
+        fh.write(json.dumps({"event": "fault", "action": "disk_enospc",
+                             "worker": 0, "path": "ckpt-00000009.msgpack.tmp",
+                             "op": "write", "at_step": 9}) + "\n")
+    violations, _ = check_storage_faults(trial, [])
+    assert violations == []  # the disk firing explains the gap too
+    sf_journal.unlink()
+    violations, _ = check_storage_faults(trial, [])
+    details = [v.detail for v in violations]
+    assert any("digest sidecar never landed" in s for s in details)
+
+
+@pytest.mark.tier1
+def test_disk_fault_script_validation():
+    """Unknown kinds and unknown fields are typed errors at arm time —
+    a chaos schedule typo must not silently no-op a campaign."""
+    with pytest.raises(ValueError, match="unknown disk fault kind"):
+        storage.DiskFaultInjector(0, [{"kind": "enospc"}])
+    with pytest.raises(ValueError, match="unknown field"):
+        storage.DiskFaultInjector(0, [{"kind": "eio", "bogus": 1}])
+
+
+@pytest.mark.tier1
+def test_durability_policy_knob():
+    """The fsync policy is a typed knob; 'full' must keep the whole
+    save protocol working (fsyncs added, semantics unchanged)."""
+    from distributedmnist_tpu.core.config import ConfigError
+    assert storage.durability() == "none"
+    with pytest.raises(ConfigError, match="valid policies"):
+        storage.set_durability("paranoid")
+    try:
+        storage.set_durability("full")
+        assert storage.journal_sync_enabled()
+    finally:
+        storage.set_durability("none")
+
+
+@pytest.mark.tier1
+def test_durability_full_save_restore_roundtrip(tmp_path):
+    try:
+        storage.set_durability("full")
+        ckpt.save_checkpoint(tmp_path, _dict_state(4), 4)
+    finally:
+        storage.set_durability("none")
+    got = ckpt.restore_checkpoint(tmp_path, _dict_state(0))
+    assert got is not None and got[2] == 4

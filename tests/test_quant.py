@@ -138,6 +138,62 @@ def test_quant_sidecar_write_read_digest_and_torn(tmp_path):
         ckpt.read_quant_sidecar(tmp_path, 8)
 
 
+@pytest.mark.tier1
+def test_enospc_sidecar_publish_never_costs_a_checkpoint(tmp_path):
+    """ISSUE 20 pin: the quant sidecar is an ADDITIVE artifact — a
+    disk that fills up mid-publish (storage-shim ENOSPC across the
+    whole retry budget) is logged by the publisher and journaled by
+    the injector, the fp32 checkpoint stays durable and loadable, and
+    a serving replica configured for the tier falls back to fp32 with
+    a journaled ``follow_quant_sidecar_fallback`` — never a crash,
+    never a checkpoint failure."""
+    from distributedmnist_tpu.quant.ptq import QuantPublisher
+    from distributedmnist_tpu.train import checkpoint as ckpt
+    from distributedmnist_tpu.train import storage
+    state = {"params": {"w": np.full((4, 3), 3.0, np.float32)},
+             "step": np.int32(3)}
+    ckpt.save_checkpoint(tmp_path, state, 3)
+    state_sd, _ = ckpt._checkpoint_state_dict(tmp_path, 3)
+    journal = tmp_path / "storage_faults.jsonl"
+    storage.arm_faults(0, [{"kind": "enospc_after_bytes", "bytes": 0,
+                            "match": ".quant.",
+                            "times": ckpt._IO_ATTEMPTS}], journal)
+    try:
+        cfg = base_config(quant={"publish_tiers": "int8",
+                                 "calibration_examples": 0})
+        pub = QuantPublisher(None, cfg, None, calib_inputs=None)
+        meta = pub.publish(tmp_path, ("full", state_sd), 3)
+        assert meta is None and pub.published == 0  # logged, swallowed
+        assert not ckpt.quant_sidecar_path(tmp_path, 3).exists()
+        # the fp32 artifact the save already landed is untouched
+        ckpt.verify_artifact(tmp_path / "ckpt-00000003.msgpack")
+        got = ckpt.restore_checkpoint(tmp_path, state)
+        assert got is not None and got[2] == 3
+        # every firing journaled — invariant 14's license survives
+        from distributedmnist_tpu.obsv.report import load_jsonl
+        recs = load_jsonl(journal)
+        assert [r["action"] for r in recs] == \
+            ["disk_enospc"] * ckpt._IO_ATTEMPTS
+        assert all(".quant." in r["path"] for r in recs)
+    finally:
+        storage.clear_faults()
+    # the serving half: tier configured, sidecar absent → journaled
+    # fp32 fallback, not an error
+    from distributedmnist_tpu.core.config import ServeConfig
+    from distributedmnist_tpu.servesvc.server import ServingReplica
+    r = ServingReplica(tmp_path, serve_dir=tmp_path / "replica",
+                       scfg=ServeConfig(precision_tier="int8"),
+                       cfg=base_config())
+    assert r._read_quant_tier(3, 0.0) is None
+    r._serve_log.close()
+    from distributedmnist_tpu.obsv.report import load_jsonl as _lj
+    swaps = _lj(tmp_path / "replica" / "serve_log.jsonl")
+    fb = [x for x in swaps
+          if x.get("action") == "follow_quant_sidecar_fallback"]
+    assert len(fb) == 1 and fb[0]["reason"] == "sidecar_absent"
+    assert fb[0]["step"] == 3 and fb[0]["tier"] == "int8"
+
+
 # ---------------------------------------------------------------------------
 # publish-time pass on a real Trainer (shared run: publish on)
 # ---------------------------------------------------------------------------

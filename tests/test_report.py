@@ -66,6 +66,62 @@ def test_load_jsonl_tolerates_torn_tail(tmp_path):
     assert rpt.load_jsonl(p, "step") == [{"event": "step", "step": 1}]
 
 
+# ---------------------------------------------------------------------------
+# tail_records: the ONE torn-tail backward scanner every poll-loop
+# reader shares (cluster.parse_poll_output, broker.tail_heartbeat,
+# loadgen.read_latest_window) — edge cases live here, once
+# ---------------------------------------------------------------------------
+
+def test_tail_records_newest_first_past_torn_tail(tmp_path):
+    from distributedmnist_tpu.obsv.journal import tail_records
+    p = tmp_path / "log.jsonl"
+    p.write_text('{"step": 1}\n{"step": 2}\n{"step": 3, "lo')
+    assert [r["step"] for r in tail_records(p)] == [2, 1]
+    # same discipline over a pre-captured text tail
+    assert [r["step"] for r in tail_records(
+        text='{"step": 1}\n{"step": 2}\n{"step": 3, "lo')] == [2, 1]
+
+
+def test_tail_records_skips_blank_nondict_and_garbage(tmp_path):
+    from distributedmnist_tpu.obsv.journal import tail_records
+    p = tmp_path / "log.jsonl"
+    p.write_text('garbage\n\n[1, 2]\n7\n"str"\n{"ok": 1}\n   \n')
+    assert list(tail_records(p)) == [{"ok": 1}]
+
+
+def test_tail_records_nothing_usable(tmp_path):
+    from distributedmnist_tpu.obsv.journal import tail_records
+    assert list(tail_records(tmp_path / "missing.jsonl")) == []
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert list(tail_records(empty)) == []
+    torn = tmp_path / "torn.jsonl"
+    torn.write_text('{"a": \n{"b"')  # every buffered line torn
+    assert list(tail_records(torn)) == []
+    assert list(tail_records(text="")) == []
+
+
+def test_tail_records_window_starts_mid_line(tmp_path):
+    # a tail_bytes window almost always begins mid-record: the torn
+    # HEAD line must be skipped exactly like a torn tail
+    from distributedmnist_tpu.obsv.journal import tail_records
+    p = tmp_path / "log.jsonl"
+    lines = "".join(json.dumps({"step": i, "pad": "x" * 40}) + "\n"
+                    for i in range(20))
+    p.write_text(lines)
+    got = [r["step"] for r in tail_records(p, tail_bytes=200)]
+    assert got and got == sorted(got, reverse=True)
+    assert 19 in got and 0 not in got  # a real window, torn head dropped
+
+
+def test_tail_records_requires_exactly_one_source(tmp_path):
+    from distributedmnist_tpu.obsv.journal import tail_records
+    with pytest.raises(ValueError, match="exactly one"):
+        list(tail_records())
+    with pytest.raises(ValueError, match="exactly one"):
+        list(tail_records(tmp_path / "x", text="{}"))
+
+
 def test_old_logs_without_time_still_get_step_figures(tmp_path):
     # regression: pre-"time"-field logs must not zero out the report
     train_dir = tmp_path / "train"
